@@ -1,0 +1,23 @@
+"""channeld-tpu: a TPU-native realtime state-routing framework.
+
+A standalone gateway for massive-online interactive systems with the
+capability surface of channeldorg/channeld (connections, channels,
+channel-data fan-out, spatial interest management, entity handover,
+recovery, replay, metrics) — re-designed so the per-tick spatial /
+area-of-interest / fan-out decision pass is a batched, device-resident
+JAX/Pallas computation sharded over a TPU mesh.
+
+Layer map (host side mirrors reference pkg/channeld; device side is new):
+
+  protocol/   wire schema + framing            (ref: pkg/channeldpb)
+  core/       connections, channels, data      (ref: pkg/channeld)
+  spatial/    grid + AOI + handover control    (ref: pkg/channeld/spatial.go)
+  ops/        JAX/Pallas batched kernels       (new: TPU decision plane)
+  parallel/   mesh sharding + halo exchange    (new: multi-chip scale-out)
+  models/     example channel-data families    (ref: examples/*pb, pkg/unrealpb)
+  client/     client SDK                       (ref: pkg/client)
+  replay/     packet record/replay             (ref: pkg/replay)
+  utils/      logging, ids, ranges
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,113 @@
+"""Per-connection finite-state-machine message filter.
+
+Capability parity with the reference FSM (ref: pkg/fsm/fsm.go:13-171):
+JSON-defined states carrying msg-type whitelists/blacklists written as
+range specs ("1", "2-65535"), optional msg-type-triggered transitions,
+and sequential ``move_to_next_state``. Each connection gets its own
+copy (ref: pkg/channeld/connection.go:317-330) so transition state is
+per-connection.
+
+The reference JSON schema is accepted verbatim so existing
+``*_fsm.json`` configs keep working:
+
+    {"States": [{"Name": ..., "MsgTypeWhitelist": "1",
+                 "MsgTypeBlacklist": ""}],
+     "InitState": "INIT",
+     "Transitions": [{"FromState": ..., "ToState": ..., "MsgType": 2}]}
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..utils.ranges import RangeSet
+
+
+@dataclass
+class FsmState:
+    name: str
+    allowed: RangeSet = field(default_factory=RangeSet)
+    blocked: RangeSet = field(default_factory=RangeSet)
+
+    def is_allowed(self, msg_type: int) -> bool:
+        return msg_type in self.allowed and msg_type not in self.blocked
+
+
+class MessageFsm:
+    def __init__(
+        self,
+        states: list[FsmState],
+        transitions: dict[tuple[str, int], str],
+        init_state: Optional[str] = None,
+    ):
+        if not states:
+            raise ValueError("FSM needs at least one state")
+        self.states = states
+        self._by_name = {s.name: s for s in states}
+        self.transitions = transitions
+        self._init_index = 0
+        if init_state is not None:
+            if init_state not in self._by_name:
+                raise KeyError(f"unknown InitState: {init_state}")
+            self._init_index = states.index(self._by_name[init_state])
+        self._current_index = self._init_index
+
+    # ---- construction -------------------------------------------------
+
+    @classmethod
+    def from_dict(cls, spec: dict) -> "MessageFsm":
+        states = [
+            FsmState(
+                name=s["Name"],
+                allowed=RangeSet.parse(s.get("MsgTypeWhitelist", "")),
+                blocked=RangeSet.parse(s.get("MsgTypeBlacklist", "")),
+            )
+            for s in spec.get("States", [])
+        ]
+        transitions = {
+            (t["FromState"], int(t["MsgType"])): t["ToState"]
+            for t in spec.get("Transitions", [])
+        }
+        return cls(states, transitions, init_state=spec.get("InitState"))
+
+    @classmethod
+    def load(cls, path: str) -> "MessageFsm":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+    def clone(self) -> "MessageFsm":
+        """Fresh per-connection copy with state reset to the init state."""
+        fsm = copy.copy(self)
+        fsm._current_index = self._init_index
+        return fsm
+
+    # ---- runtime ------------------------------------------------------
+
+    @property
+    def current(self) -> FsmState:
+        return self.states[self._current_index]
+
+    def is_allowed(self, msg_type: int) -> bool:
+        return self.current.is_allowed(msg_type)
+
+    def on_received(self, msg_type: int) -> None:
+        """Apply a msg-type-triggered transition, if one is defined."""
+        target = self.transitions.get((self.current.name, msg_type))
+        if target is not None:
+            self._move_to(target)
+
+    def move_to_next_state(self) -> bool:
+        """Advance to the next state in declaration order (auth success path)."""
+        if self._current_index + 1 < len(self.states):
+            self._current_index += 1
+            return True
+        return False
+
+    def _move_to(self, name: str) -> None:
+        state = self._by_name.get(name)
+        if state is None:
+            raise KeyError(f"unknown FSM state: {name}")
+        self._current_index = self.states.index(state)

@@ -1,0 +1,80 @@
+"""Prometheus metrics (ref: pkg/channeld/metrics.go:7-131).
+
+Same metric families as the reference — message/packet/byte rates in and
+out, dropped/fragmented/combined packets, live connection and channel
+gauges, per-channel-type tick duration — plus new TPU decision-plane
+metrics (device step latency, AOI batch size).
+"""
+
+from __future__ import annotations
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    start_http_server,
+)
+
+registry = CollectorRegistry()
+
+msg_received = Counter(
+    "messages_in", "Messages received", ["conn_type", "channel_type", "msg_type"],
+    registry=registry,
+)
+msg_sent = Counter(
+    "messages_out", "Messages sent", ["conn_type", "channel_type", "msg_type"],
+    registry=registry,
+)
+packet_received = Counter(
+    "packets_in", "Packets received", ["conn_type"], registry=registry
+)
+packet_sent = Counter("packets_out", "Packets sent", ["conn_type"], registry=registry)
+bytes_received = Counter("bytes_in", "Bytes received", ["conn_type"], registry=registry)
+bytes_sent = Counter("bytes_out", "Bytes sent", ["conn_type"], registry=registry)
+packet_dropped = Counter(
+    "packets_dropped", "Dropped packets", ["conn_type"], registry=registry
+)
+packet_fragmented = Counter(
+    "packets_fragmented", "Partially-read packets", ["conn_type"], registry=registry
+)
+packet_combined = Counter(
+    "packets_combined", "Messages combined into one packet", ["conn_type"],
+    registry=registry,
+)
+connection_num = Gauge(
+    "connection_num", "Live connections", ["conn_type"], registry=registry
+)
+channel_num = Gauge("channel_num", "Live channels", ["channel_type"], registry=registry)
+connection_closed = Counter(
+    "connection_closed", "Connections closed", ["conn_type"], registry=registry
+)
+channel_tick_duration = Histogram(
+    "channel_tick_duration_seconds",
+    "Channel tick duration",
+    ["channel_type"],
+    buckets=(0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5),
+    registry=registry,
+)
+fanout_decision_latency = Histogram(
+    "fanout_decision_latency_seconds",
+    "Latency of one fan-out decision pass (host or device)",
+    ["backend"],
+    buckets=(0.0001, 0.0005, 0.001, 0.002, 0.005, 0.01, 0.033, 0.1),
+    registry=registry,
+)
+log_events = Counter("logs", "Warn+ log records", ["level"], registry=registry)
+
+# TPU decision plane (new).
+tpu_step_latency = Histogram(
+    "tpu_spatial_step_seconds",
+    "Device AOI/fan-out step latency incl. transfers",
+    buckets=(0.0005, 0.001, 0.002, 0.005, 0.01, 0.02, 0.033, 0.1),
+    registry=registry,
+)
+tpu_entities = Gauge("tpu_entities", "Entities resident on device", registry=registry)
+
+
+def serve_metrics(port: int = 8080) -> None:
+    """Expose /metrics (reference serves this from main, cmd/main.go:50)."""
+    start_http_server(port, registry=registry)

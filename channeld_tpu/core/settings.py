@@ -1,0 +1,220 @@
+"""Global settings and CLI flag surface.
+
+Capability parity with the reference settings system
+(ref: pkg/channeld/settings.go:16-235): the same ~25 flags, the same
+channel-settings JSON schema (keyed by numeric ChannelType), and the
+same defaults, so reference config files drop in unchanged.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from dataclasses import dataclass, field, replace
+from typing import Optional
+
+from .types import ChannelAccessLevel, ChannelType, CompressionType
+
+
+@dataclass
+class ACLSettings:
+    sub: ChannelAccessLevel = ChannelAccessLevel.NONE
+    unsub: ChannelAccessLevel = ChannelAccessLevel.NONE
+    remove: ChannelAccessLevel = ChannelAccessLevel.NONE
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ACLSettings":
+        return cls(
+            sub=ChannelAccessLevel(d.get("Sub", 0)),
+            unsub=ChannelAccessLevel(d.get("Unsub", 0)),
+            remove=ChannelAccessLevel(d.get("Remove", 0)),
+        )
+
+
+@dataclass
+class ChannelSettings:
+    """(ref: settings.go:64-74 ``ChannelSettingsType``)."""
+
+    tick_interval_ms: int = 10
+    default_fanout_interval_ms: int = 20
+    default_fanout_delay_ms: int = 0
+    remove_channel_after_owner_removed: bool = False
+    send_owner_lost_and_recovered: bool = False
+    acl: ACLSettings = field(default_factory=ACLSettings)
+    data_msg_full_name: str = ""
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ChannelSettings":
+        return cls(
+            tick_interval_ms=d.get("TickIntervalMs", 10),
+            default_fanout_interval_ms=d.get("DefaultFanOutIntervalMs", 20),
+            default_fanout_delay_ms=d.get("DefaultFanOutDelayMs", 0),
+            remove_channel_after_owner_removed=d.get(
+                "RemoveChannelAfterOwnerRemoved", False
+            ),
+            send_owner_lost_and_recovered=d.get("SendOwnerLostAndRecovered", False),
+            acl=ACLSettings.from_dict(d.get("ACLSettings", {})),
+            data_msg_full_name=d.get("DataMsgFullName", ""),
+        )
+
+
+@dataclass
+class GlobalSettings:
+    """(ref: settings.go:16-56 ``GlobalSettingsType`` + defaults :76-105)."""
+
+    development: bool = False
+    log_level: Optional[int] = None
+    log_file: Optional[str] = None
+    profile: str = ""
+    profile_path: str = "profiles"
+
+    server_network: str = "tcp"
+    server_address: str = ":11288"
+    server_read_buffer_size: int = 0x0001FFFF
+    server_write_buffer_size: int = 256
+    server_fsm: str = "config/server_authoritative_fsm.json"
+    server_bypass_auth: bool = True
+    server_conn_recoverable: bool = False
+    server_conn_recover_timeout_ms: int = 0
+
+    client_network_wait_master_server: bool = True
+    client_network: str = "tcp"
+    client_address: str = ":12108"
+    client_read_buffer_size: int = 0x0001FFFF
+    client_write_buffer_size: int = 512
+    client_fsm: str = "config/client_non_authoritative_fsm.json"
+
+    compression_type: CompressionType = CompressionType.NO_COMPRESSION
+
+    max_connection_id_bits: int = 31
+
+    connection_auth_timeout_ms: int = 5000
+    max_failed_auth_attempts: int = 5
+    max_fsm_disallowed: int = 10
+
+    spatial_controller_config: Optional[str] = None
+    spatial_channel_id_start: int = 0x00010000
+    entity_channel_id_start: int = 0x00080000
+
+    channel_settings: dict[ChannelType, ChannelSettings] = field(
+        default_factory=lambda: {
+            ChannelType.GLOBAL: ChannelSettings(
+                tick_interval_ms=10,
+                default_fanout_interval_ms=20,
+            )
+        }
+    )
+
+    enable_record_packet: bool = False
+    replay_session_persistence_dir: str = ""
+
+    # TPU decision-plane settings (new — no reference counterpart).
+    spatial_backend: str = "host"  # "host" | "tpu"
+    tpu_entity_capacity: int = 1 << 17
+    tpu_query_capacity: int = 1 << 12
+
+    def get_channel_settings(self, ct: ChannelType) -> ChannelSettings:
+        st = self.channel_settings.get(ct)
+        if st is None:
+            st = self.channel_settings.get(ChannelType.GLOBAL, ChannelSettings())
+        # By-value copy, like the Go struct return — mutating the result
+        # must not silently retune another channel type's settings.
+        return replace(st, acl=replace(st.acl))
+
+    def load_channel_settings(self, path: str) -> None:
+        """Load the reference-schema channel settings JSON (keys = numeric type)."""
+        with open(path) as f:
+            raw = json.load(f)
+        for key, val in raw.items():
+            self.channel_settings[ChannelType(int(key))] = ChannelSettings.from_dict(val)
+
+    def parse_flags(self, argv: Optional[list[str]] = None) -> None:
+        """CLI flags, names matching the reference (ref: settings.go:144-235)."""
+        p = argparse.ArgumentParser(prog="channeld-tpu", add_help=True)
+        p.add_argument("-dev", action="store_true", help="run in development mode")
+        p.add_argument("-loglevel", type=int, default=None,
+                       help="-1 Debug, 0 Info, 1 Warn, 2 Error")
+        p.add_argument("-logfile", type=str, default=None)
+        p.add_argument("-profile", type=str, default="",
+                       help="cpu | mem (wall profiling of the process)")
+        p.add_argument("-profilepath", type=str, default=self.profile_path)
+        p.add_argument("-sn", type=str, default=self.server_network,
+                       help="server network type: tcp | ws")
+        p.add_argument("-sa", type=str, default=self.server_address)
+        p.add_argument("-srb", type=int, default=self.server_read_buffer_size)
+        p.add_argument("-swb", type=int, default=self.server_write_buffer_size)
+        p.add_argument("-sfsm", type=str, default=self.server_fsm)
+        p.add_argument("-sba", type=lambda s: s.lower() != "false",
+                       default=self.server_bypass_auth,
+                       help="server bypasses authentication")
+        p.add_argument("-scr", action="store_true",
+                       help="server connections recoverable")
+        p.add_argument("-scrt", type=int, default=self.server_conn_recover_timeout_ms)
+        p.add_argument("-cwm", type=lambda s: s.lower() != "false",
+                       default=self.client_network_wait_master_server)
+        p.add_argument("-cn", type=str, default=self.client_network)
+        p.add_argument("-ca", type=str, default=self.client_address)
+        p.add_argument("-crb", type=int, default=self.client_read_buffer_size)
+        p.add_argument("-cwb", type=int, default=self.client_write_buffer_size)
+        p.add_argument("-cfsm", type=str, default=self.client_fsm)
+        p.add_argument("-erp", action="store_true",
+                       help="record packets sent from clients")
+        p.add_argument("-rspd", type=str, default="")
+        p.add_argument("-ct", type=int, default=0, help="0 = none, 1 = snappy")
+        p.add_argument("-scc", type=str, default=None,
+                       help="spatial controller config JSON path")
+        p.add_argument("-scs", type=int, default=self.spatial_channel_id_start)
+        p.add_argument("-ecs", type=int, default=self.entity_channel_id_start)
+        p.add_argument("-mcb", type=int, default=self.max_connection_id_bits)
+        p.add_argument("-cat", type=int, default=self.connection_auth_timeout_ms)
+        p.add_argument("-mfaa", type=int, default=self.max_failed_auth_attempts)
+        p.add_argument("-mfd", type=int, default=self.max_fsm_disallowed)
+        p.add_argument("-chs", type=str, default="config/channel_settings_hifi.json")
+        p.add_argument("-spatial-backend", type=str, default=self.spatial_backend,
+                       choices=("host", "tpu"),
+                       help="where the AOI/fan-out decision pass runs")
+        args = p.parse_args(argv)
+
+        self.development = args.dev
+        self.log_level = args.loglevel
+        self.log_file = args.logfile
+        self.profile = args.profile
+        self.profile_path = args.profilepath
+        self.server_network = args.sn
+        self.server_address = args.sa
+        self.server_read_buffer_size = args.srb
+        self.server_write_buffer_size = args.swb
+        self.server_fsm = args.sfsm
+        self.server_bypass_auth = args.sba
+        self.server_conn_recoverable = args.scr
+        self.server_conn_recover_timeout_ms = args.scrt
+        self.client_network_wait_master_server = args.cwm
+        self.client_network = args.cn
+        self.client_address = args.ca
+        self.client_read_buffer_size = args.crb
+        self.client_write_buffer_size = args.cwb
+        self.client_fsm = args.cfsm
+        self.enable_record_packet = args.erp
+        self.replay_session_persistence_dir = args.rspd
+        self.compression_type = CompressionType(args.ct)
+        self.spatial_controller_config = args.scc
+        self.spatial_channel_id_start = args.scs
+        self.entity_channel_id_start = args.ecs
+        self.max_connection_id_bits = args.mcb
+        self.connection_auth_timeout_ms = args.cat
+        self.max_failed_auth_attempts = args.mfaa
+        self.max_fsm_disallowed = args.mfd
+        self.spatial_backend = args.spatial_backend
+        self.load_channel_settings(args.chs)
+
+
+# The process-wide settings instance (ref: settings.go ``GlobalSettings``).
+global_settings = GlobalSettings()
+
+
+def reset_global_settings() -> None:
+    """Test hook: restore defaults."""
+    global global_settings
+    fresh = GlobalSettings()
+    for f in fresh.__dataclass_fields__:
+        setattr(global_settings, f, getattr(fresh, f))

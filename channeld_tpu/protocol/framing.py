@@ -1,0 +1,113 @@
+"""Packet framing: the 5-byte tag + optional snappy body.
+
+Wire-spec parity with the reference transport
+(ref: pkg/channeld/connection.go:445-541 read side, :683-697 write side):
+
+    byte 0: 'C' (0x43)
+    byte 1: 'H' (0x48)
+    byte 2: body size high byte     (written over 'N')
+    byte 3: body size low byte      (written over 'L')
+    byte 4: CompressionType (0 none, 1 snappy)
+
+Body is a serialized ``chtpu.Packet``, at most 0xFFFF bytes after
+compression. A decoder that sees a bad magic or oversized length must
+drop the connection, mirroring the reference.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from . import snappy
+from .wire_pb2 import Packet
+
+HEADER_SIZE = 5
+MAX_PACKET_SIZE = 0xFFFF
+_MAGIC0 = 0x43  # 'C'
+_MAGIC1 = 0x48  # 'H'
+
+
+class FramingError(Exception):
+    """Fatal stream error; the connection must be closed."""
+
+
+def encode_frame(body: bytes, compression: int = 0) -> bytes:
+    """Wrap a serialized Packet into one wire frame."""
+    if compression == 1:
+        compressed = snappy.compress(body)
+        # Fall back to raw when compression doesn't help (and to keep the
+        # size cap meaningful for small payloads).
+        if len(compressed) < len(body):
+            body = compressed
+        else:
+            compression = 0
+    if len(body) > MAX_PACKET_SIZE:
+        raise FramingError(f"packet oversized: {len(body)}")
+    return bytes((_MAGIC0, _MAGIC1, (len(body) >> 8) & 0xFF, len(body) & 0xFF,
+                  compression)) + body
+
+
+def encode_packet(packet: Packet, compression: int = 0) -> bytes:
+    return encode_frame(packet.SerializeToString(), compression)
+
+
+@dataclass
+class FrameDecoder:
+    """Incremental frame decoder for a byte stream.
+
+    ``feed`` buffers arbitrary chunks and yields complete decompressed
+    packet bodies. Fragmented reads are counted for metrics parity with
+    the reference's fragmentedPacketCount.
+    """
+
+    _buf: bytearray = field(default_factory=bytearray)
+    fragmented_count: int = 0
+    # Last compression type seen from the peer; the send path mirrors it.
+    peer_compression: int = 0
+
+    def feed(self, data: bytes) -> list[bytes]:
+        # Eager, not a generator: data must land in the buffer even when
+        # the caller discards the return value (no frames yet).
+        self._buf.extend(data)
+        out: list[bytes] = []
+        while True:
+            body = self._next_frame()
+            if body is None:
+                return out
+            out.append(body)
+
+    def _next_frame(self) -> Optional[bytes]:
+        buf = self._buf
+        if len(buf) < HEADER_SIZE:
+            if buf:
+                self.fragmented_count += 1
+            return None
+        if buf[0] != _MAGIC0 or buf[1] != _MAGIC1:
+            raise FramingError(f"invalid tag: {bytes(buf[:4])!r}")
+        size = (buf[2] << 8) | buf[3]
+        if size == 0:
+            raise FramingError("zero-size frame")
+        full = HEADER_SIZE + size
+        if len(buf) < full:
+            self.fragmented_count += 1
+            return None
+        ct = buf[4]
+        body = bytes(buf[HEADER_SIZE:full])
+        del buf[:full]
+        if ct == 1:
+            self.peer_compression = 1
+            body = snappy.uncompress(body)
+        elif ct != 0:
+            # Unknown compression tags are ignored (treated as raw),
+            # mirroring the reference's CompressionType_name check.
+            pass
+        return body
+
+    def decode_packets(self, data: bytes) -> list[Packet]:
+        out = []
+        for body in self.feed(data):
+            p = Packet()
+            p.ParseFromString(body)
+            out.append(p)
+        return out

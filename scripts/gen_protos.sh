@@ -1,0 +1,8 @@
+#!/bin/sh
+# Regenerate the protobuf Python modules. Run from the repo root.
+set -e
+protoc -I. -I/usr/include --python_out=. \
+    channeld_tpu/protocol/wire.proto \
+    channeld_tpu/protocol/control.proto \
+    channeld_tpu/protocol/spatial.proto
+echo "generated: channeld_tpu/protocol/*_pb2.py"

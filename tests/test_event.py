@@ -1,0 +1,46 @@
+"""Event bus (ref: pkg/channeld/event.go semantics)."""
+
+import asyncio
+
+from channeld_tpu.core.event import Event
+
+
+def test_listen_and_broadcast():
+    ev: Event[int] = Event("t")
+    seen: list[int] = []
+    ev.listen(seen.append)
+    ev.broadcast(1)
+    ev.broadcast(2)
+    assert seen == [1, 2]
+
+
+def test_listen_once():
+    ev: Event[int] = Event("t")
+    seen: list[int] = []
+    ev.listen_once(seen.append)
+    ev.broadcast(1)
+    ev.broadcast(2)
+    assert seen == [1]
+
+
+def test_listen_for_owner_and_unlisten():
+    ev: Event[int] = Event("t")
+    seen: list[int] = []
+    owner = object()
+    ev.listen_for(owner, seen.append)
+    ev.broadcast(1)
+    ev.unlisten_for(owner)
+    ev.broadcast(2)
+    assert seen == [1]
+
+
+def test_wait():
+    ev: Event[str] = Event("t")
+
+    async def run():
+        task = asyncio.ensure_future(ev.wait())
+        await asyncio.sleep(0)
+        ev.broadcast("done")
+        return await task
+
+    assert asyncio.run(run()) == "done"

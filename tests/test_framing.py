@@ -1,0 +1,86 @@
+"""Framing + snappy codec (ref: pkg/channeld/connection.go:445-541, :683-697)."""
+
+import pytest
+
+from channeld_tpu.protocol import (
+    FrameDecoder,
+    FramingError,
+    MAX_PACKET_SIZE,
+    encode_frame,
+    encode_packet,
+    snappy,
+    wire_pb2,
+)
+
+
+def make_packet(n_msgs: int = 1, body: bytes = b"payload") -> wire_pb2.Packet:
+    p = wire_pb2.Packet()
+    for i in range(n_msgs):
+        p.messages.add(channelId=i, msgType=8, msgBody=body)
+    return p
+
+
+def test_roundtrip_uncompressed():
+    p = make_packet(3)
+    wire = encode_packet(p, compression=0)
+    assert wire[:2] == b"CH"
+    assert wire[4] == 0
+    dec = FrameDecoder()
+    got = list(dec.decode_packets(wire))
+    assert len(got) == 1
+    assert got[0] == p
+
+
+def test_roundtrip_snappy():
+    assert snappy.available()
+    p = make_packet(10, body=b"x" * 200)  # compressible
+    wire = encode_packet(p, compression=1)
+    assert wire[4] == 1
+    raw = encode_packet(p, compression=0)
+    assert len(wire) < len(raw)
+    got = list(FrameDecoder().decode_packets(wire))
+    assert got[0] == p
+
+
+def test_snappy_falls_back_when_incompressible():
+    import os
+
+    body = os.urandom(64)
+    wire = encode_frame(body, compression=1)
+    assert wire[4] == 0  # stored raw
+    assert list(FrameDecoder().feed(wire)) == [body]
+
+
+def test_fragmented_stream_reassembly():
+    p = make_packet(2)
+    wire = encode_packet(p)
+    dec = FrameDecoder()
+    out = []
+    for i in range(len(wire)):  # one byte at a time
+        out.extend(dec.decode_packets(wire[i : i + 1]))
+    assert out == [p]
+    assert dec.fragmented_count > 0
+
+
+def test_multiple_frames_in_one_chunk():
+    p1, p2 = make_packet(1), make_packet(2)
+    wire = encode_packet(p1) + encode_packet(p2)
+    assert list(FrameDecoder().decode_packets(wire)) == [p1, p2]
+
+
+def test_invalid_magic_raises():
+    dec = FrameDecoder()
+    with pytest.raises(FramingError):
+        list(dec.feed(b"XXXXX_garbage"))
+
+
+def test_oversize_rejected_on_encode():
+    with pytest.raises(FramingError):
+        encode_frame(b"z" * (MAX_PACKET_SIZE + 1))
+
+
+def test_snappy_roundtrip_raw():
+    data = b"hello hello hello hello" * 100
+    c = snappy.compress(data)
+    assert len(c) < len(data)
+    assert snappy.uncompress(c) == data

@@ -1,0 +1,109 @@
+"""FSM message filter (ref: pkg/fsm/fsm_test.go TestTransitionAndMsgAllowence)."""
+
+import json
+
+from channeld_tpu.core.fsm import MessageFsm
+from channeld_tpu.core.types import MessageType
+
+SERVER_AUTH_FSM = {
+    "States": [
+        {"Name": "INIT", "MsgTypeWhitelist": "1", "MsgTypeBlacklist": ""},
+        {"Name": "OPEN", "MsgTypeWhitelist": "2-65535", "MsgTypeBlacklist": ""},
+    ],
+    "Transitions": [],
+}
+
+
+def test_whitelist_and_next_state():
+    fsm = MessageFsm.from_dict(SERVER_AUTH_FSM)
+    assert fsm.current.name == "INIT"
+    assert fsm.is_allowed(MessageType.AUTH)
+    assert not fsm.is_allowed(MessageType.CHANNEL_DATA_UPDATE)
+
+    assert fsm.move_to_next_state()
+    assert fsm.current.name == "OPEN"
+    assert not fsm.is_allowed(MessageType.AUTH)
+    assert fsm.is_allowed(MessageType.CHANNEL_DATA_UPDATE)
+    assert fsm.is_allowed(65535)
+    assert not fsm.is_allowed(65536)
+    # Already at the last state.
+    assert not fsm.move_to_next_state()
+
+
+def test_msgtype_triggered_transition():
+    fsm = MessageFsm.from_dict(
+        {
+            "States": [
+                {"Name": "A", "MsgTypeWhitelist": "1-10", "MsgTypeBlacklist": "5"},
+                {"Name": "B", "MsgTypeWhitelist": "1-65535", "MsgTypeBlacklist": ""},
+            ],
+            "Transitions": [{"FromState": "A", "ToState": "B", "MsgType": 2}],
+        }
+    )
+    assert not fsm.is_allowed(5)  # blacklist wins inside whitelist range
+    fsm.on_received(3)
+    assert fsm.current.name == "A"  # no transition on 3
+    fsm.on_received(2)
+    assert fsm.current.name == "B"
+    assert fsm.is_allowed(5)
+
+
+def test_clone_is_independent():
+    base = MessageFsm.from_dict(SERVER_AUTH_FSM)
+    a, b = base.clone(), base.clone()
+    a.move_to_next_state()
+    assert a.current.name == "OPEN"
+    assert b.current.name == "INIT"
+
+
+def test_load_reference_format(tmp_path):
+    path = tmp_path / "fsm.json"
+    path.write_text(json.dumps(SERVER_AUTH_FSM))
+    fsm = MessageFsm.load(str(path))
+    assert [s.name for s in fsm.states] == ["INIT", "OPEN"]
+
+
+def test_reference_test_fsm_semantics():
+    """Mirror of the reference server_conn_fsm_test.json shape
+    (ref: pkg/fsm/fsm_test.go TestTransitionAndMsgAllowence)."""
+    fsm = MessageFsm.from_dict(
+        {
+            "States": [
+                {"Name": "INIT", "MsgTypeWhitelist": "1", "MsgTypeBlacklist": ""},
+                {"Name": "OPEN", "MsgTypeWhitelist": "2-10, 20", "MsgTypeBlacklist": "9"},
+                {"Name": "HANDOVER", "MsgTypeWhitelist": "21,22", "MsgTypeBlacklist": ""},
+            ],
+            "InitState": "INIT",
+            "Transitions": [
+                {"FromState": "INIT", "ToState": "OPEN", "MsgType": 1},
+                {"FromState": "OPEN", "ToState": "HANDOVER", "MsgType": 20},
+                {"FromState": "HANDOVER", "ToState": "OPEN", "MsgType": 22},
+            ],
+        }
+    )
+    assert fsm.current.name == "INIT"
+    fsm.on_received(1)
+    assert fsm.current.name == "OPEN"
+    assert fsm.is_allowed(2) and fsm.is_allowed(20)
+    assert not fsm.is_allowed(9)  # blacklisted inside whitelist span
+    assert not fsm.is_allowed(11)
+    fsm.on_received(20)
+    assert fsm.current.name == "HANDOVER"
+    assert fsm.is_allowed(21) and not fsm.is_allowed(2)
+    fsm.on_received(22)
+    assert fsm.current.name == "OPEN"
+
+
+def test_init_state_selects_start():
+    fsm = MessageFsm.from_dict(
+        {
+            "States": [
+                {"Name": "A", "MsgTypeWhitelist": "1", "MsgTypeBlacklist": ""},
+                {"Name": "B", "MsgTypeWhitelist": "2", "MsgTypeBlacklist": ""},
+            ],
+            "InitState": "B",
+            "Transitions": [],
+        }
+    )
+    assert fsm.current.name == "B"
+    assert fsm.clone().current.name == "B"

@@ -1,0 +1,80 @@
+"""Settings + reference-schema config loading (ref: pkg/channeld/settings.go)."""
+
+import json
+
+from channeld_tpu.core.settings import GlobalSettings
+from channeld_tpu.core.types import ChannelAccessLevel, ChannelType, CompressionType
+
+HIFI = {
+    "1": {
+        "TickIntervalMs": 20,
+        "DefaultFanOutIntervalMs": 20,
+        "DefaultFanOutDelayMs": 0,
+        "RemoveChannelAfterOwnerRemoved": False,
+        "SendOwnerLostAndRecovered": True,
+        "ACLSettings": {"Sub": 3, "Unsub": 3, "Remove": 0},
+    },
+    "5": {
+        "TickIntervalMs": 20,
+        "DefaultFanOutIntervalMs": 20,
+        "RemoveChannelAfterOwnerRemoved": True,
+        "SendOwnerLostAndRecovered": False,
+        "ACLSettings": {"Sub": 3, "Unsub": 3, "Remove": 2},
+        "DataMsgFullName": "tpspb.EntityChannelData",
+    },
+}
+
+
+def test_defaults_match_reference():
+    s = GlobalSettings()
+    assert s.server_address == ":11288"
+    assert s.client_address == ":12108"
+    assert s.max_connection_id_bits == 31
+    assert s.connection_auth_timeout_ms == 5000
+    assert s.spatial_channel_id_start == 0x10000
+    assert s.entity_channel_id_start == 0x80000
+    assert s.server_bypass_auth is True
+
+
+def test_load_reference_channel_settings(tmp_path):
+    path = tmp_path / "chs.json"
+    path.write_text(json.dumps(HIFI))
+    s = GlobalSettings()
+    s.load_channel_settings(str(path))
+
+    g = s.channel_settings[ChannelType.GLOBAL]
+    assert g.tick_interval_ms == 20
+    assert g.acl.sub == ChannelAccessLevel.ANY
+    assert g.acl.remove == ChannelAccessLevel.NONE
+    assert g.send_owner_lost_and_recovered is True
+
+    e = s.channel_settings[ChannelType.ENTITY]
+    assert e.remove_channel_after_owner_removed is True
+    assert e.data_msg_full_name == "tpspb.EntityChannelData"
+    assert e.acl.remove == ChannelAccessLevel.OWNER_AND_GLOBAL_OWNER
+
+
+def test_parse_flags(tmp_path):
+    path = tmp_path / "chs.json"
+    path.write_text(json.dumps(HIFI))
+    s = GlobalSettings()
+    s.parse_flags(
+        ["-dev", "-sa", ":9999", "-ct", "1", "-mcb", "16",
+         "-chs", str(path), "-spatial-backend", "tpu"]
+    )
+    assert s.development is True
+    assert s.server_address == ":9999"
+    assert s.compression_type == CompressionType.SNAPPY
+    assert s.max_connection_id_bits == 16
+    assert s.spatial_backend == "tpu"
+    # Unspecified flags keep reference defaults.
+    assert s.client_address == ":12108"
+
+
+def test_get_channel_settings_falls_back_to_global(tmp_path):
+    path = tmp_path / "chs.json"
+    path.write_text(json.dumps(HIFI))
+    s = GlobalSettings()
+    s.load_channel_settings(str(path))
+    # SUBWORLD not in config -> falls back to GLOBAL entry.
+    assert s.get_channel_settings(ChannelType.SUBWORLD).tick_interval_ms == 20
