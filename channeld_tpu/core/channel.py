@@ -1,0 +1,513 @@
+"""Channel runtime: registry, id spaces, tick loop, broadcast.
+
+Capability parity with the reference channel layer (ref: pkg/channeld/channel.go).
+Where the reference runs a goroutine per channel, we run an asyncio task per
+channel; all channel state is only touched from that task (or from the
+synchronous ``tick_once`` used by tests with a synthetic clock), preserving
+the reference's single-writer discipline without locks.
+
+Id spaces (ref: settings.go:94-95, channel.go:218-253): GLOBAL = 0,
+non-spatial 1..spatial_start-1, spatial spatial_start..entity_start-1,
+entity channels use fixed id = entity_start + entityId.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass
+from enum import IntEnum
+from typing import Callable, Optional
+
+from ..protocol import control_pb2
+from ..utils.idalloc import IdAllocator
+from ..utils.logger import get_logger
+from . import events, metrics
+from .data import ChannelData, FanOutConnection, tick_data
+from .data import (
+    reflect_channel_data_message,
+    _channel_data_extension_registry,
+    register_channel_data_type,
+)
+from .settings import global_settings
+from .types import BroadcastType, ChannelType, ConnectionType, GLOBAL_CHANNEL_ID, MessageType
+
+logger = get_logger("channel")
+
+
+class ChannelState(IntEnum):
+    INIT = 0
+    OPEN = 1
+    HANDOVER = 2
+
+
+@dataclass
+class _QueuedMessage:
+    ctx: "object"  # MessageContext; None for pure callables
+    handler: Callable
+
+
+class Channel:
+    def __init__(self, channel_id: int, channel_type: int, owner=None):
+        self.id = channel_id
+        self.channel_type = ChannelType(channel_type)
+        self.owner_connection = owner
+        self.subscribed_connections: dict = {}  # conn -> ChannelSubscription
+        self.metadata = ""
+        self.data: Optional[ChannelData] = None
+        self.latest_data_update_conn_id = 0
+        self.spatial_notifier = None
+        self.entity_controller = None
+        self.in_msg_queue: asyncio.Queue = asyncio.Queue(maxsize=1024)
+        self.fan_out_queue: list[FanOutConnection] = []
+        self.start_ns = time.monotonic_ns()
+        st = global_settings.get_channel_settings(self.channel_type)
+        self.tick_interval = st.tick_interval_ms / 1000.0
+        self.tick_frames = 0
+        self.enable_client_broadcast = False
+        self.removing = False
+        self.recoverable_subs: dict = {}  # pit -> RecoverableSubscription
+        self.logger = get_logger(f"channel.{self.channel_type.name}.{channel_id}")
+        self._tick_task: Optional[asyncio.Task] = None
+        self.state = ChannelState.OPEN if self.has_owner() else ChannelState.INIT
+
+    # ---- identity / time -------------------------------------------------
+
+    def get_time(self) -> int:
+        """Integer nanoseconds since channel creation (ref: ChannelTime)."""
+        return time.monotonic_ns() - self.start_ns
+
+    def is_removing(self) -> bool:
+        return self.removing
+
+    def __repr__(self) -> str:
+        return f"Channel({self.channel_type.name} {self.id})"
+
+    # ---- owner -----------------------------------------------------------
+
+    def get_owner(self):
+        return self.owner_connection
+
+    def set_owner(self, conn) -> None:
+        self.owner_connection = conn
+
+    def has_owner(self) -> bool:
+        conn = self.owner_connection
+        return conn is not None and not conn.is_closing()
+
+    def is_same_owner(self, other: "Channel") -> bool:
+        conn = self.get_owner()
+        return conn is not None and not conn.is_closing() and conn is other.get_owner()
+
+    # ---- data ------------------------------------------------------------
+
+    def init_data(
+        self,
+        data_msg,
+        merge_options: Optional[control_pb2.ChannelDataMergeOptions] = None,
+    ) -> None:
+        """(ref: data.go:104-131)."""
+        if data_msg is None:
+            data_msg = reflect_channel_data_message(self.channel_type)
+            if data_msg is None:
+                self.logger.info(
+                    "no channel data template registered; first update sets the data"
+                )
+        self.data = ChannelData(data_msg, merge_options)
+        initializer = getattr(data_msg, "init_data", None)
+        if callable(initializer):
+            initializer()
+        factory = _channel_data_extension_registry.get(self.channel_type)
+        if factory is not None:
+            self.data.extension = factory()
+            self.data.extension.init(self)
+
+    def get_data_message(self):
+        return self.data.msg if self.data else None
+
+    def set_data_update_conn_id(self, conn_id: int) -> None:
+        self.latest_data_update_conn_id = conn_id
+
+    # ---- message queue ---------------------------------------------------
+
+    def put_message(self, msg, handler, conn, pack) -> None:
+        """Enqueue from any task; handled in this channel's tick
+        (ref: channel.go:295-310)."""
+        if self.is_removing():
+            return
+        from .message import MessageContext
+
+        ctx = MessageContext(
+            msg_type=pack.msgType,
+            msg=msg,
+            connection=conn,
+            channel=self,
+            broadcast=pack.broadcast,
+            stub_id=pack.stubId,
+            channel_id=pack.channelId,
+            arrival_time=self.get_time(),
+        )
+        self._enqueue(_QueuedMessage(ctx, handler))
+
+    def put_message_context(self, ctx, handler) -> None:
+        if self.is_removing():
+            return
+        self._enqueue(_QueuedMessage(ctx, handler))
+
+    def put_message_internal(self, msg_type: int, msg) -> None:
+        """(ref: channel.go:319-339): sender = channel owner."""
+        if self.is_removing():
+            return
+        from .message import MESSAGE_MAP, MessageContext
+
+        entry = MESSAGE_MAP.get(msg_type)
+        if entry is None:
+            self.logger.error("no handler for message type %s", msg_type)
+            return
+        ctx = MessageContext(
+            msg_type=msg_type,
+            msg=msg,
+            connection=self.get_owner(),
+            channel=self,
+            channel_id=self.id,
+            arrival_time=self.get_time(),
+        )
+        self._enqueue(_QueuedMessage(ctx, entry.handler))
+
+    def execute(self, callback: Callable[["Channel"], None]) -> None:
+        """Run ``callback`` inside this channel's tick — the only safe way
+        to touch channel state from outside (ref: channel.go:346-352)."""
+        self._enqueue(_QueuedMessage(None, lambda _ctx: callback(self)))
+
+    def _enqueue(self, qm: _QueuedMessage) -> None:
+        try:
+            self.in_msg_queue.put_nowait(qm)
+        except asyncio.QueueFull:
+            self.logger.warning("in-queue full, dropping message")
+
+    # ---- tick ------------------------------------------------------------
+
+    def start_ticking(self) -> None:
+        if self._tick_task is None:
+            self._tick_task = asyncio.ensure_future(self._tick_loop())
+            self._tick_task.add_done_callback(self._on_tick_task_done)
+
+    def _on_tick_task_done(self, task: asyncio.Task) -> None:
+        if task.cancelled():
+            return
+        exc = task.exception()
+        if exc is not None:
+            self.logger.error("channel tick task died: %r", exc)
+
+    async def _tick_loop(self) -> None:
+        while not self.is_removing():
+            tick_start = time.monotonic()
+            self.tick_once(self.get_time(), tick_start)
+            elapsed = time.monotonic() - tick_start
+            metrics.channel_tick_duration.labels(
+                channel_type=self.channel_type.name
+            ).observe(elapsed)
+            await asyncio.sleep(max(self.tick_interval - elapsed, 0))
+
+    def tick_once(self, now: Optional[int] = None, tick_start: Optional[float] = None) -> None:
+        """One synchronous tick; ``now`` is channel time, injectable for
+        tests (ref: channel.go:358-387)."""
+        if now is None:
+            now = self.get_time()
+        if tick_start is None:
+            tick_start = time.monotonic()
+
+        # Spatial controller ticks with the GLOBAL channel only, to keep a
+        # single writer (ref: channel.go:366-369).
+        if self.channel_type == ChannelType.GLOBAL:
+            from ..spatial.controller import get_spatial_controller
+
+            controller = get_spatial_controller()
+            if controller is not None:
+                controller.tick()
+
+        self.tick_frames += 1
+        self._tick_messages(tick_start)
+        tick_data(self, now)
+        self._tick_connections()
+        self._tick_recoverable_subscriptions()
+
+    def _tick_messages(self, tick_start: float) -> None:
+        """Drain the queue within the tick budget (ref: channel.go:389-412)."""
+        while not self.in_msg_queue.empty():
+            qm = self.in_msg_queue.get_nowait()
+            # One bad message must never kill the channel task: isolate every
+            # handler (internal puts may carry no connection — e.g.
+            # RemoveChannel after owner loss — handlers guard themselves).
+            try:
+                qm.handler(qm.ctx)
+            except Exception:
+                self.logger.exception(
+                    "message handler failed (msgType=%s)",
+                    getattr(qm.ctx, "msg_type", None),
+                )
+                continue
+            if qm.ctx is None:
+                continue
+            if (
+                self.tick_interval > 0
+                and time.monotonic() - tick_start >= self.tick_interval
+            ):
+                self.logger.warning(
+                    "spent too long handling messages; %d deferred to next tick",
+                    self.in_msg_queue.qsize(),
+                )
+                break
+
+    def _tick_connections(self) -> None:
+        """Prune closed subscribers; stash recoverable subs; handle owner
+        loss (ref: channel.go:414-475)."""
+        from .message import MessageContext
+
+        for conn in list(self.subscribed_connections.keys()):
+            if not conn.is_closing():
+                continue
+
+            recover_handle = getattr(conn, "recover_handle", None)
+            if recover_handle is not None:
+                is_owner = self.get_owner() is conn
+                sub = self.subscribed_connections.get(conn)
+                if sub is not None:
+                    from .connection_recovery import RecoverableSubscription
+
+                    self.recoverable_subs[conn.pit] = RecoverableSubscription(
+                        conn_handle=recover_handle,
+                        is_owner=is_owner,
+                        old_sub_time=time.time() - self.get_time() / 1e9 + sub.sub_time / 1e9,
+                        old_sub_options=sub.options,
+                    )
+                if is_owner and global_settings.get_channel_settings(
+                    self.channel_type
+                ).send_owner_lost_and_recovered:
+                    self.broadcast(
+                        MessageContext(
+                            msg_type=MessageType.CHANNEL_OWNER_LOST,
+                            msg=control_pb2.ChannelOwnerLostMessage(),
+                            broadcast=BroadcastType.ALL_BUT_OWNER,
+                            channel_id=self.id,
+                        )
+                    )
+
+            del self.subscribed_connections[conn]
+            if self.get_owner() is conn:
+                self.set_owner(None)
+                if self.channel_type == ChannelType.GLOBAL:
+                    events.global_channel_unpossessed.broadcast(self)
+                if (
+                    global_settings.get_channel_settings(
+                        self.channel_type
+                    ).remove_channel_after_owner_removed
+                    and recover_handle is None
+                ):
+                    _remove_channel_after_owner_removed(self)
+                    return
+            else:
+                owner = self.get_owner()
+                if owner is not None:
+                    from .subscription_messages import send_unsubscribed
+
+                    send_unsubscribed(owner, self, conn, 0)
+
+    def _tick_recoverable_subscriptions(self) -> None:
+        from .connection_recovery import tick_recoverable_subscriptions
+
+        tick_recoverable_subscriptions(self)
+
+    # ---- broadcast -------------------------------------------------------
+
+    def broadcast(self, ctx) -> None:
+        """(ref: channel.go:495-520)."""
+        bc = BroadcastType(ctx.broadcast)
+        for conn in list(self.subscribed_connections.keys()):
+            if conn is None:
+                continue
+            if bc.check(BroadcastType.ALL_BUT_SENDER) and conn is ctx.connection:
+                continue
+            if bc.check(BroadcastType.ALL_BUT_OWNER) and conn is self.get_owner():
+                continue
+            if (
+                bc.check(BroadcastType.ALL_BUT_CLIENT)
+                and conn.connection_type == ConnectionType.CLIENT
+            ):
+                continue
+            if (
+                bc.check(BroadcastType.ALL_BUT_SERVER)
+                and conn.connection_type == ConnectionType.SERVER
+            ):
+                continue
+            conn.send(ctx)
+
+    def get_all_connections(self) -> set:
+        return set(self.subscribed_connections.keys())
+
+    def send_to_owner(self, ctx) -> bool:
+        conn = self.get_owner()
+        if conn is not None and not conn.is_closing():
+            conn.send(ctx)
+            return True
+        return False
+
+    def send_message_to_owner(self, msg_type: int, msg) -> bool:
+        from .message import MessageContext
+
+        return self.send_to_owner(
+            MessageContext(msg_type=msg_type, msg=msg, channel_id=self.id)
+        )
+
+    def get_handover_entities(self, entity_id: int):
+        from ..spatial.entity import get_handover_entities
+
+        return get_handover_entities(self, entity_id)
+
+
+# ---- registry -----------------------------------------------------------
+
+_all_channels: dict[int, Channel] = {}
+_global_channel: Optional[Channel] = None
+_non_spatial_alloc: Optional[IdAllocator] = None
+_spatial_alloc: Optional[IdAllocator] = None
+
+
+class ChannelFullError(Exception):
+    pass
+
+
+def init_channels() -> None:
+    """(ref: channel.go:118-150). Creates the GLOBAL channel and registers
+    channel-data types named in the settings."""
+    global _global_channel, _non_spatial_alloc, _spatial_alloc
+    if _global_channel is not None:
+        return
+    _non_spatial_alloc = IdAllocator(1, global_settings.spatial_channel_id_start - 1)
+    _spatial_alloc = IdAllocator(
+        global_settings.spatial_channel_id_start,
+        global_settings.entity_channel_id_start - 1,
+    )
+    _global_channel = create_channel_with_id(GLOBAL_CHANNEL_ID, ChannelType.GLOBAL, None)
+
+    import importlib
+
+    from google.protobuf import symbol_database
+
+    for mod in global_settings.import_modules:
+        try:
+            importlib.import_module(mod)
+        except ImportError:
+            logger.error("failed to import data-type module %s", mod)
+
+    for ch_type, st in global_settings.channel_settings.items():
+        if not st.data_msg_full_name:
+            continue
+        try:
+            cls = symbol_database.Default().GetSymbol(st.data_msg_full_name)
+        except KeyError:
+            logger.error(
+                "failed to find message type %s for channel data", st.data_msg_full_name
+            )
+            continue
+        register_channel_data_type(ch_type, cls())
+
+
+def get_channel(channel_id: int) -> Optional[Channel]:
+    return _all_channels.get(channel_id)
+
+
+def get_global_channel() -> Optional[Channel]:
+    return _global_channel
+
+
+def all_channels() -> dict[int, Channel]:
+    return _all_channels
+
+
+def create_channel_with_id(channel_id: int, channel_type: int, owner) -> Channel:
+    ch = Channel(channel_id, channel_type, owner)
+    if ch.channel_type == ChannelType.ENTITY:
+        from ..spatial.controller import get_spatial_controller
+        from ..spatial.entity import FlatEntityGroupController
+
+        ch.spatial_notifier = get_spatial_controller()
+        ch.entity_controller = FlatEntityGroupController()
+        ch.entity_controller.initialize(ch)
+    _all_channels[ch.id] = ch
+    try:
+        asyncio.get_running_loop()
+        ch.start_ticking()
+    except RuntimeError:
+        pass  # no loop (tests drive tick_once by hand)
+    metrics.channel_num.labels(channel_type=ch.channel_type.name).inc()
+    events.channel_created.broadcast(ch)
+    return ch
+
+
+def create_channel(channel_type: int, owner) -> Channel:
+    """(ref: channel.go:211-256). GLOBAL cannot be re-created; spatial ids
+    come from their own space."""
+    if channel_type == ChannelType.GLOBAL and _global_channel is not None:
+        raise ValueError("GLOBAL channel already exists")
+    if channel_type == ChannelType.SPATIAL:
+        channel_id = _spatial_alloc.next_id(lambda i: i in _all_channels)
+        if channel_id is None:
+            raise ChannelFullError("spatial channels are full")
+    else:
+        channel_id = _non_spatial_alloc.next_id(lambda i: i in _all_channels)
+        if channel_id is None:
+            raise ChannelFullError("non-spatial channels are full")
+    return create_channel_with_id(channel_id, channel_type, owner)
+
+
+def create_entity_channel(entity_id: int, owner) -> Channel:
+    """Entity channels use the fixed id == entityId, which must lie in the
+    entity id space (ref: message_spatial.go:204-213, channel.go:229-241)."""
+    if entity_id < global_settings.entity_channel_id_start:
+        raise ValueError(f"entityId {entity_id} below the entity channel id space")
+    if entity_id in _all_channels:
+        raise ChannelFullError(f"entity channel {entity_id} already exists")
+    return create_channel_with_id(entity_id, ChannelType.ENTITY, owner)
+
+
+def remove_channel(ch: Channel) -> None:
+    """(ref: channel.go:258-282)."""
+    events.channel_removing.broadcast(ch)
+    if ch.channel_type == ChannelType.ENTITY and ch.entity_controller is not None:
+        ch.entity_controller.uninitialize(ch)
+        events.auth_complete.unlisten_for(ch)
+    ch.removing = True
+    if ch._tick_task is not None:
+        ch._tick_task.cancel()
+        ch._tick_task = None
+    _all_channels.pop(ch.id, None)
+    metrics.channel_num.labels(channel_type=ch.channel_type.name).dec()
+    events.channel_removed.broadcast(ch.id)
+
+
+def _remove_channel_after_owner_removed(ch: Channel) -> None:
+    """(ref: channel.go:477-493)."""
+    ch.removing = True
+    if ch is not _global_channel and _global_channel is not None:
+        from .message import MESSAGE_MAP
+        from ..protocol import wire_pb2
+
+        _global_channel.put_message(
+            control_pb2.RemoveChannelMessage(channelId=ch.id),
+            MESSAGE_MAP[MessageType.REMOVE_CHANNEL].handler,
+            None,
+            wire_pb2.MessagePack(channelId=GLOBAL_CHANNEL_ID, msgType=MessageType.REMOVE_CHANNEL),
+        )
+    ch.logger.info("removing channel after the owner is removed")
+
+
+def reset_channels() -> None:
+    """Test hook: drop every channel including GLOBAL."""
+    global _global_channel
+    for ch in list(_all_channels.values()):
+        ch.removing = True
+        if ch._tick_task is not None:
+            ch._tick_task.cancel()
+    _all_channels.clear()
+    _global_channel = None

@@ -1,0 +1,454 @@
+"""Connection layer: registry, id allocation, dispatch, send batching.
+
+Capability parity with the reference connection layer
+(ref: pkg/channeld/connection.go). Each connection owns a frame decoder
+(bytes in), a send queue of MessagePacks flushed as batched packets with
+oversize carry-over (bytes out), a per-connection FSM filter, and the
+replay recording hook. Transport IO is behind the small ``Transport``
+seam so tests can use in-process pipes, mirroring the reference's
+``MessageSender`` / ``net.Pipe`` seams.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional, Protocol
+
+from ..protocol import FramingError, MESSAGE_TEMPLATES, encode_frame, wire_pb2
+from ..protocol.framing import FrameDecoder, HEADER_SIZE, MAX_PACKET_SIZE
+from ..protocol import snappy as snappy_codec
+from ..utils.idalloc import hash_string
+from ..utils.logger import get_logger
+from . import events, metrics
+from .fsm import MessageFsm
+from .settings import global_settings
+from .types import (
+    CompressionType,
+    ConnectionState,
+    ConnectionType,
+    MessageType,
+)
+
+logger = get_logger("connection")
+
+
+class Transport(Protocol):
+    """Byte sink for a connection; implemented by TCP/WebSocket adapters
+    and by test pipes."""
+
+    def write(self, data: bytes) -> None: ...
+    def close(self) -> None: ...
+    def remote_addr(self) -> Optional[tuple]: ...
+
+
+class MessageSender(Protocol):
+    """Send-path seam (ref: connection.go:39-41). Tests may swap it to
+    capture outgoing messages."""
+
+    def send(self, conn: "Connection", ctx) -> None: ...
+
+
+class QueuedMessagePackSender:
+    """Marshal into the send queue; flushed by the connection's pump
+    (ref: connection.go:54-84)."""
+
+    def send(self, conn: "Connection", ctx) -> None:
+        body = ctx.msg.SerializeToString()
+        mp = wire_pb2.MessagePack(
+            channelId=ctx.channel_id,
+            broadcast=ctx.broadcast,
+            stubId=ctx.stub_id,
+            msgType=ctx.msg_type,
+            msgBody=body,
+        )
+        if mp.ByteSize() >= MAX_PACKET_SIZE - HEADER_SIZE:
+            conn.logger.warning(
+                "message dropped: size %d exceeds packet limit", mp.ByteSize()
+            )
+            return
+        if not conn.is_closing():
+            conn.send_queue.append(mp)
+
+
+class Connection:
+    def __init__(
+        self,
+        conn_id: int,
+        connection_type: ConnectionType,
+        transport: Transport,
+        fsm: Optional[MessageFsm],
+    ):
+        self.id = conn_id
+        self.connection_type = ConnectionType(connection_type)
+        self.compression_type = CompressionType.NO_COMPRESSION
+        self.transport = transport
+        self.decoder = FrameDecoder()
+        self.sender: MessageSender = QueuedMessagePackSender()
+        self.send_queue: list[wire_pb2.MessagePack] = []
+        self.oversized_msg_pack: Optional[wire_pb2.MessagePack] = None
+        self.pit = ""
+        self.fsm = fsm
+        self.fsm_disallowed_counter = 0
+        self.state = ConnectionState.UNAUTHENTICATED
+        self.conn_time = time.monotonic()
+        self.close_handlers: list[Callable[[], None]] = []
+        self.replay_session = None
+        self.spatial_subscriptions: dict[int, object] = {}
+        self.recover_handle = None
+        self.logger = get_logger(f"conn.{self.connection_type.name}.{conn_id}")
+        if self._is_packet_recording_enabled():
+            from ..replay.session import ReplaySession
+
+            self.replay_session = ReplaySession()
+
+    # ---- receive path ----------------------------------------------------
+
+    def on_bytes(self, data: bytes) -> None:
+        """Feed raw stream bytes; dispatches every complete packet.
+        Fatal framing/parse errors close the connection (ref: readPacket)."""
+        ct_name = self.connection_type.name
+        try:
+            packets = self.decoder.decode_packets(data)
+        except Exception as e:  # framing violations and protobuf DecodeError alike
+            self.logger.warning("bad inbound frame, closing connection: %s", e)
+            metrics.connection_closed.labels(conn_type=ct_name).inc()
+            self.close()
+            return
+        metrics.bytes_received.labels(conn_type=ct_name).inc(len(data))
+        for packet in packets:
+            metrics.packet_received.labels(conn_type=ct_name).inc()
+            if self._is_packet_recording_enabled() and self.replay_session is not None:
+                self.replay_session.record(packet)
+            for mp in packet.messages:
+                self.receive_message(mp)
+
+    def receive_message(self, mp: wire_pb2.MessagePack) -> None:
+        """Dispatch one message pack to its channel queue
+        (ref: connection.go:547-615)."""
+        from .channel import get_channel
+        from .message import (
+            MESSAGE_MAP,
+            handle_client_to_server_user_message,
+            handle_server_to_client_user_message,
+        )
+
+        channel = get_channel(mp.channelId)
+        if channel is None:
+            if mp.msgType not in (
+                MessageType.SUB_TO_CHANNEL,
+                MessageType.UNSUB_FROM_CHANNEL,
+            ):
+                self.logger.warning(
+                    "can't find channel %d for msgType %d", mp.channelId, mp.msgType
+                )
+            return
+
+        entry = MESSAGE_MAP.get(mp.msgType)
+        if entry is None and mp.msgType < MessageType.USER_SPACE_START:
+            self.logger.error("undefined message type %d", mp.msgType)
+            return
+
+        if self.fsm is not None and not self.fsm.is_allowed(mp.msgType):
+            events.fsm_disallowed.broadcast(
+                events.FsmDisallowedData(connection=self, msg_type=mp.msgType)
+            )
+            self.logger.warning(
+                "message type %d not allowed in state %s",
+                mp.msgType,
+                self.fsm.current.name,
+            )
+            return
+
+        if mp.msgType >= MessageType.USER_SPACE_START and entry is None:
+            if self.connection_type == ConnectionType.CLIENT:
+                # client -> server: body stays opaque (never deserialized).
+                msg = wire_pb2.ServerForwardMessage(
+                    clientConnId=self.id, payload=mp.msgBody
+                )
+                handler = handle_client_to_server_user_message
+            else:
+                msg = wire_pb2.ServerForwardMessage()
+                try:
+                    msg.ParseFromString(mp.msgBody)
+                except Exception:
+                    self.logger.exception("unmarshalling ServerForwardMessage")
+                    return
+                handler = handle_server_to_client_user_message
+        else:
+            tmpl = entry.template
+            # Registry entries may hold the class or a prototype instance;
+            # either way every dispatch gets a fresh message (ref: proto.Clone).
+            msg = tmpl() if isinstance(tmpl, type) else type(tmpl)()
+            try:
+                msg.ParseFromString(mp.msgBody)
+            except Exception:
+                self.logger.exception("unmarshalling message type %d", mp.msgType)
+                return
+            handler = entry.handler
+
+        if self.fsm is not None:
+            self.fsm.on_received(mp.msgType)
+
+        channel.put_message(msg, handler, self, mp)
+        metrics.msg_received.labels(
+            conn_type=self.connection_type.name,
+            channel_type=channel.channel_type.name,
+            msg_type=str(mp.msgType),
+        ).inc()
+
+    # ---- send path -------------------------------------------------------
+
+    def send(self, ctx) -> None:
+        if self.is_closing():
+            return
+        self.sender.send(self, ctx)
+
+    def flush(self) -> None:
+        """Batch queued messages into one packet (<= 64KB with oversize
+        carry-over), compress, frame, write (ref: connection.go:626-714)."""
+        if not self.send_queue and self.oversized_msg_pack is None:
+            return
+        p = wire_pb2.Packet()
+        if self.oversized_msg_pack is not None:
+            p.messages.append(self.oversized_msg_pack)
+            self.oversized_msg_pack = None
+        size = p.ByteSize()
+        while self.send_queue:
+            mp = self.send_queue.pop(0)
+            # Field tag + length prefix costs a few bytes beyond the body.
+            size += mp.ByteSize() + 6
+            if p.messages and size > MAX_PACKET_SIZE:
+                self.oversized_msg_pack = mp
+                break
+            p.messages.append(mp)
+            metrics.msg_sent.labels(
+                conn_type=self.connection_type.name,
+                channel_type="",
+                msg_type=str(mp.msgType),
+            ).inc()
+        if not p.messages:
+            return
+        if len(p.messages) > 1:
+            metrics.packet_combined.labels(conn_type=self.connection_type.name).inc()
+        body = p.SerializeToString()
+        ct = self.compression_type
+        if ct == CompressionType.SNAPPY and not snappy_codec.available():
+            ct = CompressionType.NO_COMPRESSION
+        try:
+            frame = encode_frame(body, int(ct))
+        except FramingError as e:
+            self.logger.error("packet oversized at flush: %s", e)
+            return
+        try:
+            self.transport.write(frame)
+        except Exception as e:
+            self.logger.error("error writing packet: %s", e)
+            return
+        metrics.packet_sent.labels(conn_type=self.connection_type.name).inc()
+        metrics.bytes_sent.labels(conn_type=self.connection_type.name).inc(len(frame))
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def add_close_handler(self, handler: Callable[[], None]) -> None:
+        self.close_handlers.append(handler)
+
+    def close(self, unexpected: bool = False) -> None:
+        """(ref: connection.go:351-380). ``unexpected=True`` marks an
+        abnormal close, enabling recovery for recoverable server conns."""
+        if self.is_closing():
+            return
+        if self._is_packet_recording_enabled() and self.replay_session is not None:
+            self.replay_session.persist(
+                global_settings.replay_session_persistence_dir, self.id
+            )
+        for handler in self.close_handlers:
+            try:
+                handler()
+            except Exception:
+                self.logger.exception("close handler failed")
+        if (
+            unexpected
+            and self.connection_type == ConnectionType.SERVER
+            and global_settings.server_conn_recoverable
+        ):
+            from .connection_recovery import make_recoverable
+
+            make_recoverable(self)
+        self.state = ConnectionState.CLOSING
+        try:
+            self.transport.close()
+        except Exception:
+            pass
+        self.send_queue.clear()
+        _all_connections.pop(self.id, None)
+        from .ddos import untrack_unauthenticated
+
+        untrack_unauthenticated(self.id)
+        metrics.connection_num.labels(conn_type=self.connection_type.name).dec()
+        self.logger.info("closed connection")
+
+    def disconnect(self) -> None:
+        """Graceful server-initiated disconnect (DisconnectMessage path)."""
+        self.flush()
+
+    def is_closing(self) -> bool:
+        return self.state >= ConnectionState.CLOSING
+
+    def on_authenticated(self, pit: str) -> None:
+        """(ref: Connection.OnAuthenticated). Promotes the FSM past the
+        auth state and, for recoverable PITs, starts recovery."""
+        from .connection_recovery import get_recover_handle, recover_from_handle
+
+        if self.state == ConnectionState.AUTHENTICATED:
+            return
+        self.state = ConnectionState.AUTHENTICATED
+        self.pit = pit
+        from .ddos import untrack_unauthenticated
+
+        untrack_unauthenticated(self.id)
+        if self.fsm is not None:
+            self.fsm.move_to_next_state()
+        handle = get_recover_handle(pit)
+        if handle is not None and not handle.is_timed_out():
+            recover_from_handle(self, handle)
+
+    def should_recover(self) -> bool:
+        return self.recover_handle is not None
+
+    # ---- queries ---------------------------------------------------------
+
+    def has_authority_over(self, ch) -> bool:
+        """(ref: channel.go:540-549): global owner or channel owner."""
+        from .channel import get_global_channel
+
+        gch = get_global_channel()
+        if gch is not None and gch.get_owner() is self:
+            return True
+        return ch.get_owner() is self
+
+    def has_interest_in(self, spatial_ch_id: int) -> bool:
+        return spatial_ch_id in self.spatial_subscriptions
+
+    def remote_addr(self) -> Optional[tuple]:
+        return self.transport.remote_addr()
+
+    def remote_ip(self) -> Optional[str]:
+        addr = self.remote_addr()
+        return addr[0] if addr else None
+
+    def _is_packet_recording_enabled(self) -> bool:
+        return (
+            self.connection_type == ConnectionType.CLIENT
+            and global_settings.enable_record_packet
+        )
+
+    def __repr__(self) -> str:
+        return f"Connection({self.connection_type.name} {self.id})"
+
+
+# ---- registry ------------------------------------------------------------
+
+_all_connections: dict[int, Connection] = {}
+_next_connection_id = 0
+_server_fsm: Optional[MessageFsm] = None
+_client_fsm: Optional[MessageFsm] = None
+
+
+def init_connections(
+    server_fsm_path: Optional[str] = None, client_fsm_path: Optional[str] = None
+) -> None:
+    """(ref: connection.go:116-155)."""
+    global _server_fsm, _client_fsm
+    if server_fsm_path:
+        _server_fsm = MessageFsm.load(server_fsm_path)
+    if client_fsm_path:
+        _client_fsm = MessageFsm.load(client_fsm_path)
+    from .message import init_message_map
+
+    init_message_map()
+
+
+def set_fsm_templates(server_fsm: Optional[MessageFsm], client_fsm: Optional[MessageFsm]) -> None:
+    global _server_fsm, _client_fsm
+    _server_fsm = server_fsm
+    _client_fsm = client_fsm
+
+
+def get_connection(conn_id: int) -> Optional[Connection]:
+    conn = _all_connections.get(conn_id)
+    if conn is None or conn.is_closing():
+        return None
+    return conn
+
+
+def _generate_conn_id(transport: Transport, max_conn_id: int) -> int:
+    """Dev: sequential. Prod: hash(addr) ^ time, less guessable
+    (ref: connection.go:244-257)."""
+    global _next_connection_id
+    if global_settings.development:
+        _next_connection_id += 1
+        if _next_connection_id >= max_conn_id:
+            raise RuntimeError("connection id space exhausted")
+        return _next_connection_id
+    addr = transport.remote_addr()
+    h = hash_string(str(addr)) ^ int(time.time_ns() & 0xFFFFFFFF)
+    return h & max_conn_id
+
+
+def add_connection(transport: Transport, conn_type: ConnectionType) -> Connection:
+    """(ref: connection.go:260-345). Banned IPs are refused at the accept
+    point (ref: connection.go:228-235)."""
+    from .ddos import is_ip_banned
+
+    addr = transport.remote_addr()
+    if addr is not None and is_ip_banned(addr[0]):
+        get_logger("connection").info("refused connection of banned IP %s", addr[0])
+        try:
+            transport.close()
+        except Exception:
+            pass
+        raise ConnectionRefusedError(f"banned IP {addr[0]}")
+    max_conn_id = (1 << global_settings.max_connection_id_bits) - 1
+    conn_id = None
+    for _ in range(100):
+        candidate = _generate_conn_id(transport, max_conn_id)
+        if candidate not in _all_connections:
+            conn_id = candidate
+            break
+    if conn_id is None:
+        raise RuntimeError("could not find a free connection id")
+
+    if conn_type == ConnectionType.SERVER:
+        fsm_template = _server_fsm
+    elif conn_type == ConnectionType.CLIENT:
+        fsm_template = _client_fsm
+    else:
+        raise ValueError(f"invalid connection type {conn_type}")
+    fsm = fsm_template.clone() if fsm_template is not None else None
+
+    conn = Connection(conn_id, conn_type, transport, fsm)
+    _all_connections[conn_id] = conn
+    from .ddos import track_unauthenticated
+
+    track_unauthenticated(conn)
+    metrics.connection_num.labels(conn_type=conn.connection_type.name).inc()
+    return conn
+
+
+def all_connections() -> dict[int, Connection]:
+    return _all_connections
+
+
+def flush_all() -> None:
+    for conn in list(_all_connections.values()):
+        if not conn.is_closing():
+            conn.flush()
+
+
+def reset_connections() -> None:
+    """Test hook."""
+    global _next_connection_id
+    for conn in list(_all_connections.values()):
+        conn.state = ConnectionState.CLOSING
+    _all_connections.clear()
+    _next_connection_id = 0

@@ -1,0 +1,213 @@
+"""Server-connection recovery (ref: pkg/channeld/connection_recovery.go).
+
+When a recoverable server connection drops unexpectedly, a PIT-keyed
+handle preserves its previous connection id, and each channel stashes the
+old subscription (and owner flag). When a connection re-authenticates
+with the same PIT, it reclaims the previous id, channels re-subscribe it
+(skipping the first fan-out), stream ``ChannelDataRecoveryMessage`` with
+full state + extension payload, and after the recovery window a single
+``RECOVERY_END`` closes the process.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Optional
+
+from ..protocol import control_pb2
+from ..utils.anyutil import pack_any
+from ..utils.logger import get_logger
+from .settings import global_settings
+from .types import BroadcastType, ChannelType, GLOBAL_CHANNEL_ID, MessageType
+
+if TYPE_CHECKING:
+    from .channel import Channel
+    from .connection import Connection
+
+logger = get_logger("recovery")
+
+# Window for all channels to stream their recovery data before RECOVERY_END
+# (ref: connection_recovery.go:15-16).
+CHANNEL_DATA_RECOVERY_TIMEOUT = 1.0
+
+
+@dataclass
+class ConnectionRecoverHandle:
+    prev_conn_id: int
+    disconn_time: float
+    new_conn: Optional["Connection"] = None
+    start_recovery_time: float = 0.0
+
+    def is_timed_out(self) -> bool:
+        timeout_ms = global_settings.server_conn_recover_timeout_ms
+        return timeout_ms > 0 and (time.monotonic() - self.disconn_time) > timeout_ms / 1000.0
+
+
+@dataclass
+class RecoverableSubscription:
+    conn_handle: ConnectionRecoverHandle
+    is_owner: bool
+    old_sub_time: float
+    old_sub_options: control_pb2.ChannelSubscriptionOptions = field(
+        default_factory=control_pb2.ChannelSubscriptionOptions
+    )
+
+
+# PIT -> handle (ref: connectionRecoverHandles map).
+_recover_handles: dict[str, ConnectionRecoverHandle] = {}
+
+
+def get_recover_handle(pit: str) -> Optional[ConnectionRecoverHandle]:
+    return _recover_handles.get(pit)
+
+
+def make_recoverable(conn: "Connection") -> None:
+    """(ref: connection_recovery.go:34-41)."""
+    handle = ConnectionRecoverHandle(
+        prev_conn_id=conn.id, disconn_time=time.monotonic()
+    )
+    _recover_handles[conn.pit] = handle
+    conn.recover_handle = handle
+
+
+def recover_from_handle(conn: "Connection", handle: ConnectionRecoverHandle) -> None:
+    """Reclaim the previous connection id (ref: connection_recovery.go:47-63)."""
+    from . import connection as connection_mod
+
+    prev = connection_mod._all_connections.pop(handle.prev_conn_id, None)
+    if prev is not None and prev is not conn and not prev.is_closing():
+        # Previous id is still actively used — recovery fails.
+        connection_mod._all_connections[handle.prev_conn_id] = prev
+        conn.logger.error("failed to recover: previous connection id is in use")
+        return
+    connection_mod._all_connections.pop(conn.id, None)
+    conn.id = handle.prev_conn_id
+    connection_mod._all_connections[conn.id] = conn
+    conn.recover_handle = handle
+    handle.new_conn = conn
+    handle.start_recovery_time = time.monotonic()
+
+
+def tick_connection_recovery_once() -> None:
+    """Reap timed-out handles; end completed recoveries
+    (ref: connection_recovery.go:65-92)."""
+    from .message import MessageContext
+
+    for pit, handle in list(_recover_handles.items()):
+        if handle.is_timed_out():
+            del _recover_handles[pit]
+            continue
+        if handle.new_conn is None:
+            continue
+        if time.monotonic() - handle.start_recovery_time > CHANNEL_DATA_RECOVERY_TIMEOUT:
+            handle.new_conn.send(
+                MessageContext(
+                    msg_type=MessageType.RECOVERY_END,
+                    msg=control_pb2.EndRecoveryMessage(),
+                    channel_id=GLOBAL_CHANNEL_ID,
+                )
+            )
+            handle.new_conn.recover_handle = None
+            del _recover_handles[pit]
+
+
+async def connection_recovery_loop() -> None:
+    while True:
+        tick_connection_recovery_once()
+        await asyncio.sleep(1.0)
+
+
+def tick_recoverable_subscriptions(ch: "Channel") -> None:
+    """Per-channel recovery tick (ref: connection_recovery.go:94-171)."""
+    from .channel import _remove_channel_after_owner_removed
+    from .message import MessageContext
+    from .subscription import subscribe_to_channel
+
+    for pit, rsub in list(ch.recoverable_subs.items()):
+        handle = rsub.conn_handle
+        if handle.is_timed_out():
+            ch.recoverable_subs.clear()
+            if global_settings.get_channel_settings(
+                ch.channel_type
+            ).remove_channel_after_owner_removed:
+                _remove_channel_after_owner_removed(ch)
+            break
+
+        if handle.new_conn is None:
+            continue
+
+        new_conn = handle.new_conn
+        if rsub.is_owner:
+            if ch.has_owner():
+                ch.logger.warning("failed to restore channel owner: already owned")
+            else:
+                ch.set_owner(new_conn)
+                if ch.channel_type == ChannelType.GLOBAL:
+                    from . import events
+
+                    events.global_channel_possessed.broadcast(ch)
+
+        # The recovered subscriber already has (stale) state; recovery data
+        # replaces the first full fan-out.
+        rsub.old_sub_options.skipFirstFanOut = True
+        subscribe_to_channel(new_conn, ch, rsub.old_sub_options)
+
+        data_msg = ch.get_data_message()
+        if data_msg is None:
+            del ch.recoverable_subs[pit]
+            continue
+        recovery_msg = control_pb2.ChannelDataRecoveryMessage(
+            channelId=ch.id,
+            channelType=ch.channel_type,
+            metadata=ch.metadata,
+            subTime=int(rsub.old_sub_time * 1000),
+            subOptions=rsub.old_sub_options,
+            channelData=pack_any(data_msg),
+        )
+        if ch.has_owner():
+            recovery_msg.ownerConnId = ch.get_owner().id
+        if ch.data is not None and ch.data.extension is not None:
+            ext_msg = ch.data.extension.get_recovery_data_message()
+            if ext_msg is not None:
+                recovery_msg.recoveryData.CopyFrom(pack_any(ext_msg))
+        new_conn.send(
+            MessageContext(
+                msg_type=MessageType.RECOVERY_CHANNEL_DATA,
+                msg=recovery_msg,
+                channel_id=ch.id,
+            )
+        )
+        del ch.recoverable_subs[pit]
+
+        if global_settings.get_channel_settings(
+            ch.channel_type
+        ).send_owner_lost_and_recovered:
+            _schedule_owner_recovered_broadcast(ch)
+
+
+def _schedule_owner_recovered_broadcast(ch: "Channel") -> None:
+    """Broadcast CHANNEL_OWNER_RECOVERED after the recovery window."""
+    from .message import MessageContext
+
+    def _broadcast():
+        ch.broadcast(
+            MessageContext(
+                msg_type=MessageType.CHANNEL_OWNER_RECOVERED,
+                msg=control_pb2.ChannelOwnerRecoveredMessage(),
+                broadcast=BroadcastType.ALL_BUT_OWNER,
+                channel_id=ch.id,
+            )
+        )
+
+    try:
+        loop = asyncio.get_running_loop()
+        loop.call_later(CHANNEL_DATA_RECOVERY_TIMEOUT, _broadcast)
+    except RuntimeError:
+        _broadcast()  # no loop (tests): deliver immediately
+
+
+def reset_recovery() -> None:
+    """Test hook."""
+    _recover_handles.clear()

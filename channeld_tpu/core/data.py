@@ -1,0 +1,325 @@
+"""ChannelData: state, update buffering, merge, and fan-out scheduling.
+
+Capability parity with the reference data plane (ref: pkg/channeld/data.go):
+the channel state message, a bounded ring of buffered updates, per-subscriber
+fan-out on independent cadences with accumulation of the updates that arrived
+in (lastFanOut, nextFanOut], first-fan-out-sends-full-state, field-mask
+filtering, and reflection- or custom-merge with merge options.
+
+The per-subscriber "is it due / what accumulates" decision here is the
+host-semantics path; ops/fanout.py provides the batched device equivalent
+used by the TPU decision plane.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Callable, Optional, Protocol, runtime_checkable
+
+from google.protobuf.message import Message
+
+from ..protocol import control_pb2
+from ..utils.anyutil import pack_any, unpack_any
+from ..utils.fieldmask import filter_fields
+from ..utils.logger import get_logger
+from .types import ChannelDataAccess, MessageType
+
+if TYPE_CHECKING:
+    from .channel import Channel
+
+logger = get_logger("data")
+
+MAX_UPDATE_MSG_BUFFER_SIZE = 512
+
+# channel-type -> protobuf template for reflection-created channel data
+# (ref: data.go:62 RegisterChannelDataType).
+_channel_data_type_registry: dict[int, Message] = {}
+# channel-type -> ChannelDataExtension factory (ref: data.go:390-416).
+_channel_data_extension_registry: dict[int, Callable[[], "ChannelDataExtension"]] = {}
+
+
+def register_channel_data_type(channel_type: int, template: Message) -> None:
+    if channel_type in _channel_data_type_registry:
+        logger.warning("channel data type already registered for %s", channel_type)
+        return
+    _channel_data_type_registry[channel_type] = template
+
+
+def set_channel_data_extension(
+    channel_type: int, factory: Callable[[], "ChannelDataExtension"]
+) -> None:
+    _channel_data_extension_registry[channel_type] = factory
+
+
+def reflect_channel_data_message(channel_type: int) -> Optional[Message]:
+    template = _channel_data_type_registry.get(channel_type)
+    if template is None:
+        return None
+    return type(template)()
+
+
+def reset_registries() -> None:
+    """Test hook."""
+    _channel_data_type_registry.clear()
+    _channel_data_extension_registry.clear()
+
+
+@runtime_checkable
+class MergeableChannelData(Protocol):
+    """Custom-merge hook (ref: data.go:321-324). Implemented by game data
+    types that can fold an update in faster than reflection merge."""
+
+    def merge(
+        self,
+        src: Message,
+        options: Optional[control_pb2.ChannelDataMergeOptions],
+        spatial_notifier,
+    ) -> None: ...
+
+
+@runtime_checkable
+class ChannelDataInitializer(Protocol):
+    """(ref: data.go:30-33)."""
+
+    def init_data(self) -> None: ...
+
+
+class ChannelDataExtension(Protocol):
+    """Per-channel auxiliary state used for recovery payloads
+    (ref: data.go:390-393)."""
+
+    def init(self, channel: "Channel") -> None: ...
+    def get_recovery_data_message(self) -> Optional[Message]: ...
+
+
+# Channel time is integer nanoseconds since channel start (ref: ChannelTime
+# is an int64 time.Duration) — integer math keeps window comparisons exact.
+NS_PER_MS = 1_000_000
+
+
+@dataclass
+class UpdateBufferElement:
+    update_msg: Message
+    arrival_time: int  # ns, channel time
+    sender_conn_id: int
+    message_index: int
+
+
+@dataclass
+class FanOutConnection:
+    """(ref: data.go:39-44)."""
+
+    conn: object  # ConnectionInChannel
+    had_first_fanout: bool = False
+    last_fanout_time: int = 0  # ns, channel time
+    last_message_index: int = 0
+
+
+class ChannelData:
+    def __init__(
+        self,
+        msg: Optional[Message],
+        merge_options: Optional[control_pb2.ChannelDataMergeOptions] = None,
+    ):
+        self.msg = msg
+        self.merge_options = merge_options
+        self.update_msg_buffer: list[UpdateBufferElement] = []
+        self.accumulated_update_msg: Optional[Message] = (
+            type(msg)() if msg is not None else None
+        )
+        self.msg_index = 0
+        self.max_fanout_interval_ms = 0
+        self.extension: Optional[ChannelDataExtension] = None
+
+    def on_update(
+        self,
+        update_msg: Message,
+        arrival_time: int,
+        sender_conn_id: int,
+        spatial_notifier=None,
+    ) -> None:
+        """(ref: data.go:149-173)."""
+        if self.msg is None:
+            self.msg = update_msg
+            logger.info(
+                "initialized channel data with update message from conn %d",
+                sender_conn_id,
+            )
+        else:
+            merge_with_options(self.msg, update_msg, self.merge_options, spatial_notifier)
+        self.msg_index += 1
+        self.update_msg_buffer.append(
+            UpdateBufferElement(update_msg, arrival_time, sender_conn_id, self.msg_index)
+        )
+        if len(self.update_msg_buffer) > MAX_UPDATE_MSG_BUFFER_SIZE:
+            oldest = self.update_msg_buffer[0]
+            # Only drop it once every subscriber must have seen it.
+            if oldest.arrival_time + self.max_fanout_interval_ms * NS_PER_MS < arrival_time:
+                self.update_msg_buffer.pop(0)
+
+
+def tick_data(channel: "Channel", now: int) -> None:
+    """The per-tick fan-out decision + send loop (ref: data.go:175-291).
+
+    ``now`` is channel time (integer ns since channel start) so tests can
+    drive it with a synthetic clock.
+    """
+    data = channel.data
+    if data is None or data.msg is None:
+        return
+
+    queue = channel.fan_out_queue
+    for foc in list(queue):
+        conn = foc.conn
+        if conn is None or conn.is_closing():
+            try:
+                queue.remove(foc)
+            except ValueError:
+                pass
+            continue
+        cs = channel.subscribed_connections.get(conn)
+        if cs is None or cs.options.dataAccess == ChannelDataAccess.NO_ACCESS:
+            continue
+
+        #  |------FanOutDelay------|---FanOutInterval---|
+        #  subTime                 firstFanOut          secondFanOut
+        next_fanout_time = foc.last_fanout_time + cs.options.fanOutIntervalMs * NS_PER_MS
+        if now < next_fanout_time:
+            continue
+
+        latest_fanout_time = next_fanout_time
+        if data.accumulated_update_msg is None:
+            data.accumulated_update_msg = type(data.msg)()
+        else:
+            data.accumulated_update_msg.Clear()
+        has_ever_merged = False
+
+        if not foc.had_first_fanout:
+            # First fan-out carries the full channel state.
+            fan_out_data_update(channel, conn, cs, data.msg)
+            foc.had_first_fanout = True
+            foc.last_message_index = data.msg_index
+            latest_fanout_time = now
+        elif data.update_msg_buffer:
+            last_update_time = max(foc.last_fanout_time, 0)
+            for be in data.update_msg_buffer:
+                if be.sender_conn_id == conn.id and cs.options.skipSelfUpdateFanOut:
+                    continue
+                if last_update_time <= be.arrival_time <= next_fanout_time:
+                    if not has_ever_merged:
+                        data.accumulated_update_msg.MergeFrom(be.update_msg)
+                    else:
+                        merge_with_options(
+                            data.accumulated_update_msg,
+                            be.update_msg,
+                            data.merge_options,
+                            None,
+                        )
+                    has_ever_merged = True
+                    last_update_time = be.arrival_time
+                    foc.last_message_index = be.message_index
+            if has_ever_merged:
+                fan_out_data_update(channel, conn, cs, data.accumulated_update_msg)
+
+        foc.last_fanout_time = latest_fanout_time
+
+    # Keep the queue ordered by last_fanout_time (the reference maintains
+    # this invariant with in-place move-to-back; a stable sort is the same
+    # end state).
+    queue.sort(key=lambda f: f.last_fanout_time)
+
+
+def fan_out_data_update(channel: "Channel", conn, cs, update_msg: Message) -> None:
+    """(ref: data.go:293-318)."""
+    if cs.options.dataFieldMasks:
+        update_msg = _filtered_copy(update_msg, list(cs.options.dataFieldMasks))
+    from .message import MessageContext  # local: message imports data
+
+    conn.send(
+        MessageContext(
+            msg_type=MessageType.CHANNEL_DATA_UPDATE,
+            msg=control_pb2.ChannelDataUpdateMessage(data=pack_any(update_msg)),
+            channel=channel,
+            channel_id=channel.id,
+        )
+    )
+
+
+def _filtered_copy(msg: Message, masks: list[str]) -> Message:
+    # The same accumulated message fans out to many subscribers with
+    # different masks — never mutate the shared instance.
+    out = type(msg)()
+    out.CopyFrom(msg)
+    filter_fields(out, masks)
+    return out
+
+
+def merge_with_options(
+    dst: Message,
+    src: Message,
+    options: Optional[control_pb2.ChannelDataMergeOptions],
+    spatial_notifier=None,
+) -> None:
+    """(ref: data.go:326-347)."""
+    merge = getattr(dst, "merge", None)
+    if callable(merge):
+        if options is None:
+            options = control_pb2.ChannelDataMergeOptions(
+                shouldCheckRemovableMapField=True
+            )
+        try:
+            merge(src, options, spatial_notifier)
+        except Exception:
+            logger.exception("custom merge error")
+    else:
+        reflect_merge(dst, src, options)
+
+
+def reflect_merge(
+    dst: Message,
+    src: Message,
+    options: Optional[control_pb2.ChannelDataMergeOptions],
+) -> None:
+    """Reflection-based merge honoring merge options (ref: data.go:349-388)."""
+    dst.MergeFrom(src)
+    if options is None:
+        return
+    for fd, value in dst.ListFields():
+        is_map = (
+            fd.type == fd.TYPE_MESSAGE and fd.message_type.GetOptions().map_entry
+        )
+        if is_map:
+            if options.shouldCheckRemovableMapField:
+                field_map = getattr(dst, fd.name)
+                value_desc = fd.message_type.fields_by_name["value"]
+                if value_desc.type == value_desc.TYPE_MESSAGE and (
+                    "removed" in value_desc.message_type.fields_by_name
+                ):
+                    for key in [
+                        k for k, v in field_map.items() if getattr(v, "removed", False)
+                    ]:
+                        del field_map[key]
+        elif fd.is_repeated:
+            lst = getattr(dst, fd.name)
+            if options.shouldReplaceList:
+                src_list = getattr(src, fd.name)
+                del lst[:]
+                lst.extend(src_list)
+            if options.listSizeLimit > 0:
+                offset = len(lst) - options.listSizeLimit
+                if offset > 0:
+                    if options.truncateTop:
+                        keep = list(lst[offset:])
+                    else:
+                        keep = list(lst[: options.listSizeLimit])
+                    del lst[:]
+                    lst.extend(keep)
+
+
+def unwrap_update_any(any_msg) -> Message:
+    return unpack_any(any_msg)
+
+
+def channel_now() -> float:
+    return _time.monotonic()

@@ -1,0 +1,125 @@
+"""Anti-DDoS: auth-failure counters, IP/PIT blacklists, unauth-timeout
+reaper (ref: pkg/channeld/ddos.go).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Optional
+
+from ..utils.logger import security_logger
+from . import events
+from .auth import AuthResult
+from .settings import global_settings
+from .types import ConnectionState, ConnectionType
+
+_failed_auth_counters: dict[str, int] = {}
+_ip_blacklist: dict[str, float] = {}
+_pit_blacklist: dict[str, float] = {}
+# conn_id -> Connection, pending authentication.
+_unauthenticated_connections: dict[int, object] = {}
+
+
+def is_ip_banned(ip: Optional[str]) -> bool:
+    return ip in _ip_blacklist
+
+
+def is_pit_banned(pit: str) -> bool:
+    return pit in _pit_blacklist
+
+
+def track_unauthenticated(conn) -> None:
+    if global_settings.connection_auth_timeout_ms > 0:
+        _unauthenticated_connections[conn.id] = conn
+
+
+def untrack_unauthenticated(conn_id: int) -> None:
+    _unauthenticated_connections.pop(conn_id, None)
+
+
+def on_auth_result(conn, result, pit: str = "") -> None:
+    """Failed-auth accounting (ref: ddos.go:18-46). Called from the auth
+    completion path for both outcomes; ``pit`` comes from the auth message
+    (the connection only learns its PIT on success)."""
+    if conn.connection_type == ConnectionType.SERVER:
+        return
+    if result == AuthResult.INVALID_LT:
+        key = pit
+        _failed_auth_counters[key] = _failed_auth_counters.get(key, 0) + 1
+        limit = global_settings.max_failed_auth_attempts
+        if limit > 0 and _failed_auth_counters[key] >= limit:
+            _pit_blacklist[key] = time.monotonic()
+            security_logger().info("blacklisted PIT %s: too many failed auths", key)
+            conn.close()
+    elif result == AuthResult.INVALID_PIT:
+        ip = conn.remote_ip()
+        if ip is None:
+            return
+        _failed_auth_counters[ip] = _failed_auth_counters.get(ip, 0) + 1
+        limit = global_settings.max_failed_auth_attempts
+        if limit > 0 and _failed_auth_counters[ip] >= limit:
+            _ip_blacklist[ip] = time.monotonic()
+            security_logger().info("blacklisted IP %s: too many failed auths", ip)
+            conn.close()
+
+
+def init_anti_ddos() -> None:
+    """Wire the FSM-disallowed listener (ref: ddos.go:17-63).
+
+    Auth results are routed through on_auth_result directly (our auth path
+    knows the result), so only the FSM listener needs the event bus.
+    """
+
+    def _on_fsm_disallowed(data: events.FsmDisallowedData) -> None:
+        conn = data.connection
+        if conn.connection_type == ConnectionType.SERVER:
+            return
+        conn.fsm_disallowed_counter += 1
+        limit = global_settings.max_fsm_disallowed
+        if limit > 0 and conn.fsm_disallowed_counter >= limit:
+            _pit_blacklist[conn.pit] = time.monotonic()
+            security_logger().info(
+                "blacklisted PIT %s: too many FSM-disallowed messages", conn.pit
+            )
+            conn.close()
+
+    events.fsm_disallowed.listen(_on_fsm_disallowed)
+
+
+def check_unauth_conns_once() -> None:
+    """Close + blacklist connections that never authenticated
+    (ref: ddos.go:66-82)."""
+    timeout_s = global_settings.connection_auth_timeout_ms / 1000.0
+    if timeout_s <= 0:
+        return
+    now = time.monotonic()
+    for conn in list(_unauthenticated_connections.values()):
+        if conn.is_closing():
+            _unauthenticated_connections.pop(conn.id, None)
+            continue
+        if (
+            conn.state == ConnectionState.UNAUTHENTICATED
+            and now - conn.conn_time >= timeout_s
+        ):
+            ip = conn.remote_ip()
+            if ip is not None:
+                _ip_blacklist[ip] = now
+            conn.close()
+            security_logger().info(
+                "closed and blacklisted unauthenticated connection from %s", ip
+            )
+
+
+async def unauth_reaper_loop() -> None:
+    while True:
+        check_unauth_conns_once()
+        await asyncio.sleep(0.5)
+
+
+def reset_ddos() -> None:
+    """Test hook."""
+    _failed_auth_counters.clear()
+    _ip_blacklist.clear()
+    _pit_blacklist.clear()
+    _unauthenticated_connections.clear()

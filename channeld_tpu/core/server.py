@@ -1,0 +1,190 @@
+"""Gateway server: listeners, per-connection reactors, bootstrap.
+
+Capability parity with the reference entrypoint wiring
+(ref: cmd/main.go:39-54, pkg/channeld/connection.go:186-242):
+ParseFlag -> InitLogs -> InitMetrics -> InitConnections -> InitChannels ->
+InitSpatialController -> serve /metrics -> StartListening(SERVER) ->
+[wait GlobalChannelPossessed] -> StartListening(CLIENT).
+
+Transports: TCP (asyncio streams) and WebSocket (ref: connection_websocket.go);
+both feed the same Connection byte path. A single 1ms flush task batches the
+send queues of every connection (the reference runs one flush goroutine per
+connection; a shared pump is the asyncio-idiomatic equivalent).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from ..utils.logger import get_logger, init_logs
+from . import events
+from .channel import init_channels
+from .connection import Connection, add_connection, all_connections, init_connections
+from .connection_recovery import connection_recovery_loop
+from .ddos import init_anti_ddos, unauth_reaper_loop
+from .settings import global_settings
+from .types import ConnectionType
+
+logger = get_logger("server")
+
+
+class TcpTransport:
+    def __init__(self, writer: asyncio.StreamWriter):
+        self.writer = writer
+
+    def write(self, data: bytes) -> None:
+        if not self.writer.is_closing():
+            self.writer.write(data)
+
+    def close(self) -> None:
+        if not self.writer.is_closing():
+            self.writer.close()
+
+    def remote_addr(self) -> Optional[tuple]:
+        return self.writer.get_extra_info("peername")
+
+
+class WebSocketTransport:
+    """Wraps a ``websockets`` server connection as a byte sink; each frame
+    is one binary WS message (ref: connection_websocket.go:14-61)."""
+
+    def __init__(self, ws, loop: asyncio.AbstractEventLoop):
+        self.ws = ws
+        self.loop = loop
+
+    def write(self, data: bytes) -> None:
+        asyncio.ensure_future(self._send(data), loop=self.loop)
+
+    async def _send(self, data: bytes) -> None:
+        try:
+            await self.ws.send(data)
+        except Exception:
+            pass
+
+    def close(self) -> None:
+        asyncio.ensure_future(self.ws.close(), loop=self.loop)
+
+    def remote_addr(self) -> Optional[tuple]:
+        return self.ws.remote_address
+
+
+def _parse_addr(addr: str) -> tuple[str, int]:
+    host, _, port = addr.rpartition(":")
+    return host or "0.0.0.0", int(port)
+
+
+async def start_listening(conn_type: ConnectionType, network: str, addr: str):
+    """(ref: connection.go:186-242). Returns the server object."""
+    host, port = _parse_addr(addr)
+    if network == "tcp":
+        async def on_client(reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+            try:
+                sock = writer.get_extra_info("socket")
+                if sock is not None:
+                    import socket as _socket
+
+                    sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+                conn = add_connection(TcpTransport(writer), conn_type)
+            except ConnectionRefusedError:
+                writer.close()
+                return
+            await _reactor(conn, reader)
+
+        server = await asyncio.start_server(on_client, host, port)
+        logger.info("listening for %s on tcp %s:%d", conn_type.name, host, port)
+        return server
+    elif network in ("ws", "websocket"):
+        import websockets
+
+        loop = asyncio.get_running_loop()
+
+        async def on_ws(ws):
+            try:
+                conn = add_connection(WebSocketTransport(ws, loop), conn_type)
+            except ConnectionRefusedError:
+                await ws.close()
+                return
+            try:
+                async for message in ws:
+                    if isinstance(message, str):
+                        message = message.encode()
+                    conn.on_bytes(message)
+                    if conn.is_closing():
+                        break
+            except websockets.ConnectionClosed:
+                pass
+            finally:
+                conn.close(unexpected=True)
+
+        server = await websockets.serve(on_ws, host, port, max_size=1 << 20)
+        logger.info("listening for %s on ws %s:%d", conn_type.name, host, port)
+        return server
+    raise ValueError(f"unsupported network type: {network}")
+
+
+async def _reactor(conn: Connection, reader: asyncio.StreamReader) -> None:
+    """Per-connection receive loop (ref: the per-conn recv goroutine)."""
+    try:
+        while not conn.is_closing():
+            data = await reader.read(65536)
+            if not data:
+                break
+            conn.on_bytes(data)
+    except (ConnectionResetError, asyncio.IncompleteReadError, OSError):
+        pass
+    finally:
+        # EOF/error: an unexpected close from the peer's side.
+        conn.close(unexpected=True)
+
+
+async def flush_loop(interval: float = 0.001) -> None:
+    """Shared send pump: batch + flush every connection's queue
+    (ref: the per-conn 1ms flush goroutine, connection.go:180-184)."""
+    while True:
+        for conn in list(all_connections().values()):
+            if not conn.is_closing() and (conn.send_queue or conn.oversized_msg_pack):
+                conn.flush()
+        await asyncio.sleep(interval)
+
+
+async def run_server(argv: Optional[list[str]] = None) -> None:
+    """Full bootstrap (ref: cmd/main.go:12-56)."""
+    global_settings.parse_flags(argv)
+    init_logs(development=global_settings.development)
+    init_connections(global_settings.server_fsm, global_settings.client_fsm)
+    init_channels()
+    init_anti_ddos()
+
+    from ..spatial.controller import init_spatial_controller
+
+    init_spatial_controller()
+
+    from .metrics import serve_metrics
+
+    try:
+        serve_metrics(8080)
+    except OSError:
+        logger.warning("metrics port 8080 unavailable; /metrics disabled")
+
+    tasks = [
+        asyncio.ensure_future(flush_loop()),
+        asyncio.ensure_future(unauth_reaper_loop()),
+    ]
+    if global_settings.server_conn_recoverable:
+        tasks.append(asyncio.ensure_future(connection_recovery_loop()))
+
+    await start_listening(
+        ConnectionType.SERVER,
+        global_settings.server_network,
+        global_settings.server_address,
+    )
+    if global_settings.client_network_wait_master_server:
+        logger.info("waiting for the GLOBAL channel to be possessed...")
+        await events.global_channel_possessed.wait()
+    await start_listening(
+        ConnectionType.CLIENT,
+        global_settings.client_network,
+        global_settings.client_address,
+    )
+    await asyncio.gather(*tasks)

@@ -108,6 +108,11 @@ class GlobalSettings:
     enable_record_packet: bool = False
     replay_session_persistence_dir: str = ""
 
+    # Python modules imported at init so game-defined protobuf types are
+    # resolvable from Any payloads (the reference gets this for free from
+    # each main importing its pb package; ours is a flag/config concern).
+    import_modules: list[str] = field(default_factory=list)
+
     # TPU decision-plane settings (new — no reference counterpart).
     spatial_backend: str = "host"  # "host" | "tpu"
     tpu_entity_capacity: int = 1 << 17
@@ -170,6 +175,9 @@ class GlobalSettings:
         p.add_argument("-mfaa", type=int, default=self.max_failed_auth_attempts)
         p.add_argument("-mfd", type=int, default=self.max_fsm_disallowed)
         p.add_argument("-chs", type=str, default="config/channel_settings_hifi.json")
+        p.add_argument("-imports", type=str, default="",
+                       help="comma-separated Python modules providing game "
+                            "protobuf types (e.g. mygame.data_pb2)")
         p.add_argument("-spatial-backend", type=str, default=self.spatial_backend,
                        choices=("host", "tpu"),
                        help="where the AOI/fan-out decision pass runs")
@@ -205,6 +213,7 @@ class GlobalSettings:
         self.max_failed_auth_attempts = args.mfaa
         self.max_fsm_disallowed = args.mfd
         self.spatial_backend = args.spatial_backend
+        self.import_modules = [m for m in args.imports.split(",") if m]
         self.load_channel_settings(args.chs)
 
 

@@ -1,0 +1,103 @@
+"""Channel subscriptions and fan-out queue membership.
+
+Capability parity with the reference (ref: pkg/channeld/subscription.go):
+per-subscription options merged over channel-type defaults, re-subscription
+merges options (reporting whether data access changed), fan-out queue entry
+with delayed first fan-out, and the spatial-subscription mirror on the
+connection used by ``has_interest_in``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import TYPE_CHECKING, Optional
+
+from ..protocol import control_pb2
+from .data import FanOutConnection, NS_PER_MS
+from .settings import global_settings
+from .types import ChannelDataAccess, ChannelType
+
+if TYPE_CHECKING:
+    from .channel import Channel
+
+
+@dataclass
+class ChannelSubscription:
+    options: control_pb2.ChannelSubscriptionOptions
+    sub_time: int  # ns, channel time
+    fanout_conn: FanOutConnection
+
+
+def default_sub_options(channel_type: int) -> control_pb2.ChannelSubscriptionOptions:
+    st = global_settings.get_channel_settings(ChannelType(channel_type))
+    return control_pb2.ChannelSubscriptionOptions(
+        dataAccess=ChannelDataAccess.READ_ACCESS,
+        dataFieldMasks=[],
+        fanOutIntervalMs=st.default_fanout_interval_ms,
+        fanOutDelayMs=st.default_fanout_delay_ms,
+        skipSelfUpdateFanOut=True,
+        skipFirstFanOut=False,
+    )
+
+
+def subscribe_to_channel(
+    conn, ch: "Channel", options: Optional[control_pb2.ChannelSubscriptionOptions]
+) -> tuple[Optional[ChannelSubscription], bool]:
+    """Returns (subscription, should_send_result).
+
+    Re-subscription merges options and reports True only when data access
+    changed (ref: subscription.go:34-102).
+    """
+    if conn.is_closing():
+        return None, False
+
+    cs = ch.subscribed_connections.get(conn)
+    if cs is not None:
+        data_access_changed = False
+        if options is not None:
+            before = cs.options.dataAccess
+            cs.options.MergeFrom(options)
+            data_access_changed = before != cs.options.dataAccess
+        return cs, data_access_changed
+
+    merged = default_sub_options(ch.channel_type)
+    if options is not None:
+        merged.MergeFrom(options)
+
+    now = ch.get_time()
+    foc = FanOutConnection(
+        conn=conn,
+        # skipFirstFanOut pretends the full-state send already happened.
+        had_first_fanout=merged.skipFirstFanOut,
+        # Delay the first fan-out so spawn messages can arrive first.
+        last_fanout_time=now + merged.fanOutDelayMs * NS_PER_MS,
+    )
+    cs = ChannelSubscription(options=merged, sub_time=now, fanout_conn=foc)
+    ch.fan_out_queue.insert(0, foc)
+
+    if ch.data is not None and ch.data.max_fanout_interval_ms < merged.fanOutIntervalMs:
+        ch.data.max_fanout_interval_ms = merged.fanOutIntervalMs
+
+    ch.subscribed_connections[conn] = cs
+
+    if ch.channel_type == ChannelType.SPATIAL:
+        conn.spatial_subscriptions[ch.id] = cs.options
+
+    return cs, True
+
+
+def unsubscribe_from_channel(
+    conn, ch: "Channel"
+) -> control_pb2.ChannelSubscriptionOptions:
+    """(ref: subscription.go:104-125). Raises KeyError if not subscribed."""
+    cs = ch.subscribed_connections.get(conn)
+    if cs is None:
+        raise KeyError(f"connection {conn.id} is not subscribed to channel {ch.id}")
+    try:
+        ch.fan_out_queue.remove(cs.fanout_conn)
+    except ValueError:
+        pass
+    del ch.subscribed_connections[conn]
+    if ch.channel_type == ChannelType.SPATIAL:
+        conn.spatial_subscriptions.pop(ch.id, None)
+    return cs.options

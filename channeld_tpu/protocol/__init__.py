@@ -4,7 +4,7 @@ Reference counterpart: pkg/channeldpb. Regenerate the ``*_pb2`` modules
 with ``scripts/gen_protos.sh`` after editing the ``.proto`` files.
 """
 
-from . import control_pb2, spatial_pb2, wire_pb2
+from . import control_pb2, replay_pb2, spatial_pb2, wire_pb2
 from .framing import (
     FrameDecoder,
     FramingError,

@@ -4,5 +4,7 @@ set -e
 protoc -I. -I/usr/include --python_out=. \
     channeld_tpu/protocol/wire.proto \
     channeld_tpu/protocol/control.proto \
-    channeld_tpu/protocol/spatial.proto
+    channeld_tpu/protocol/spatial.proto \
+    channeld_tpu/protocol/replay.proto \
+    channeld_tpu/models/testdata.proto
 echo "generated: channeld_tpu/protocol/*_pb2.py"

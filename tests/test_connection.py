@@ -1,0 +1,318 @@
+"""Connection layer: dispatch, auth flow, FSM gating, flush batching.
+
+(ref: pkg/channeld/connection_test.go, message_test.go, ddos_test.go —
+in-process transports instead of real sockets.)
+"""
+
+import pytest
+
+from channeld_tpu.core import connection as connection_mod
+from channeld_tpu.core.channel import get_channel, get_global_channel
+from channeld_tpu.core.connection import add_connection
+from channeld_tpu.core.fsm import MessageFsm
+from channeld_tpu.core.settings import global_settings
+from channeld_tpu.core.types import (
+    ChannelType,
+    ConnectionState,
+    ConnectionType,
+    MessageType,
+)
+from channeld_tpu.protocol import FrameDecoder, control_pb2, encode_packet, wire_pb2
+from channeld_tpu.utils.anyutil import pack_any
+
+from helpers import FakeTransport, fresh_runtime
+
+AUTH_FSM = {
+    "States": [
+        {"Name": "INIT", "MsgTypeWhitelist": "1", "MsgTypeBlacklist": ""},
+        {"Name": "OPEN", "MsgTypeWhitelist": "2-65535", "MsgTypeBlacklist": ""},
+    ],
+    "Transitions": [],
+}
+
+
+@pytest.fixture(autouse=True)
+def runtime():
+    gch = fresh_runtime()
+    global_settings.development = True
+    connection_mod.set_fsm_templates(
+        MessageFsm.from_dict(AUTH_FSM), MessageFsm.from_dict(AUTH_FSM)
+    )
+    yield gch
+
+
+def wire(msg_type: int, msg, channel_id: int = 0, stub_id: int = 0) -> bytes:
+    p = wire_pb2.Packet(
+        messages=[
+            wire_pb2.MessagePack(
+                channelId=channel_id,
+                stubId=stub_id,
+                msgType=msg_type,
+                msgBody=msg.SerializeToString(),
+            )
+        ]
+    )
+    return encode_packet(p)
+
+
+def sent_messages(transport: FakeTransport) -> list:
+    """Decode everything the server flushed to this transport."""
+    dec = FrameDecoder()
+    out = []
+    for chunk in transport.written:
+        for packet in dec.decode_packets(chunk):
+            out.extend(packet.messages)
+    return out
+
+
+def auth_client(name="alice"):
+    t = FakeTransport()
+    conn = add_connection(t, ConnectionType.CLIENT)
+    conn.on_bytes(
+        wire(MessageType.AUTH, control_pb2.AuthMessage(playerIdentifierToken=name))
+    )
+    get_global_channel().tick_once(0)
+    conn.flush()
+    return conn, t
+
+
+def test_auth_flow_end_to_end():
+    conn, t = auth_client()
+    msgs = sent_messages(t)
+    assert len(msgs) == 1
+    assert msgs[0].msgType == MessageType.AUTH
+    result = control_pb2.AuthResultMessage()
+    result.ParseFromString(msgs[0].msgBody)
+    assert result.result == control_pb2.AuthResultMessage.SUCCESSFUL
+    assert result.connId == conn.id
+    assert conn.state == ConnectionState.AUTHENTICATED
+    assert conn.fsm.current.name == "OPEN"
+
+
+def test_fsm_blocks_preauth_messages():
+    t = FakeTransport()
+    conn = add_connection(t, ConnectionType.CLIENT)
+    # Data update before auth: FSM must reject it.
+    conn.on_bytes(
+        wire(
+            MessageType.CHANNEL_DATA_UPDATE,
+            control_pb2.ChannelDataUpdateMessage(),
+        )
+    )
+    get_global_channel().tick_once(0)
+    conn.flush()
+    assert sent_messages(t) == []
+
+
+def test_create_channel_and_update_roundtrip():
+    from channeld_tpu.models import testdata_pb2
+
+    conn, t = auth_client()
+    t.written.clear()
+    conn.on_bytes(
+        wire(
+            MessageType.CREATE_CHANNEL,
+            control_pb2.CreateChannelMessage(
+                channelType=ChannelType.SUBWORLD,
+                metadata="room1",
+                data=pack_any(testdata_pb2.TestChannelDataMessage(text="hello")),
+            ),
+            stub_id=7,
+        )
+    )
+    get_global_channel().tick_once(0)
+    conn.flush()
+    msgs = sent_messages(t)
+    types = [m.msgType for m in msgs]
+    assert MessageType.CREATE_CHANNEL in types
+    assert MessageType.SUB_TO_CHANNEL in types
+    created = control_pb2.CreateChannelResultMessage()
+    created.ParseFromString(
+        [m for m in msgs if m.msgType == MessageType.CREATE_CHANNEL][0].msgBody
+    )
+    assert created.channelId == 1
+    ch = get_channel(created.channelId)
+    assert ch is not None and ch.metadata == "room1"
+    assert ch.get_owner() is conn
+    assert ch.get_data_message().text == "hello"
+
+    # Owner sends an update; next owner-due tick fans it back out only after
+    # data changes — first fan-out (full state) happens on the channel tick.
+    t.written.clear()
+    conn.on_bytes(
+        wire(
+            MessageType.CHANNEL_DATA_UPDATE,
+            control_pb2.ChannelDataUpdateMessage(
+                data=pack_any(testdata_pb2.TestChannelDataMessage(text="world"))
+            ),
+            channel_id=ch.id,
+        )
+    )
+    ch.tick_once(ch.get_time())
+    assert ch.get_data_message().text == "world"
+
+
+def test_list_channel_with_filters():
+    conn, t = auth_client()
+    for meta in ("alpha", "beta"):
+        conn.on_bytes(
+            wire(
+                MessageType.CREATE_CHANNEL,
+                control_pb2.CreateChannelMessage(
+                    channelType=ChannelType.SUBWORLD, metadata=meta
+                ),
+            )
+        )
+    get_global_channel().tick_once(0)
+    t.written.clear()
+    conn.on_bytes(
+        wire(
+            MessageType.LIST_CHANNEL,
+            control_pb2.ListChannelMessage(metadataFilters=["alp"]),
+        )
+    )
+    get_global_channel().tick_once(0)
+    conn.flush()
+    msgs = [
+        m for m in sent_messages(t) if m.msgType == MessageType.LIST_CHANNEL
+    ]
+    assert len(msgs) == 1
+    result = control_pb2.ListChannelResultMessage()
+    result.ParseFromString(msgs[0].msgBody)
+    assert [c.metadata for c in result.channels] == ["alpha"]
+
+
+def test_flush_batches_multiple_messages_into_one_packet():
+    conn, t = auth_client()
+    t.written.clear()
+    from channeld_tpu.core.message import MessageContext
+
+    for i in range(5):
+        conn.send(
+            MessageContext(
+                msg_type=MessageType.LIST_CHANNEL,
+                msg=control_pb2.ListChannelResultMessage(),
+                channel_id=0,
+            )
+        )
+    conn.flush()
+    assert len(t.written) == 1  # one frame
+    assert len(sent_messages(t)) == 5
+
+
+def test_oversize_carryover():
+    conn, t = auth_client()
+    t.written.clear()
+    from channeld_tpu.core.message import MessageContext
+    from channeld_tpu.models import testdata_pb2
+
+    big = testdata_pb2.TestChannelDataMessage(text="x" * 30000)
+    for _ in range(4):
+        conn.send(
+            MessageContext(
+                msg_type=MessageType.CHANNEL_DATA_UPDATE,
+                msg=control_pb2.ChannelDataUpdateMessage(data=pack_any(big)),
+            )
+        )
+    conn.flush()
+    conn.flush()
+    assert len(t.written) == 2  # two frames, each under the 64KB cap
+    assert len(sent_messages(t)) == 4
+
+
+def test_garbage_bytes_close_connection():
+    t = FakeTransport()
+    conn = add_connection(t, ConnectionType.CLIENT)
+    conn.on_bytes(b"GET / HTTP/1.1\r\nHost: x\r\n\r\n")
+    assert conn.is_closing()
+    assert t.closed
+
+
+def test_unauth_timeout_blacklists_ip():
+    """(ref: ddos_test.go TestUnauthTimeout)."""
+    from channeld_tpu.core import ddos
+
+    global_settings.connection_auth_timeout_ms = 0  # disabled: no reap
+    t = FakeTransport()
+    conn = add_connection(t, ConnectionType.CLIENT)
+    ddos.check_unauth_conns_once()
+    assert not conn.is_closing()
+
+    global_settings.connection_auth_timeout_ms = 1
+    ddos.track_unauthenticated(conn)
+    conn.conn_time -= 10  # pretend it connected 10s ago
+    ddos.check_unauth_conns_once()
+    assert conn.is_closing()
+    assert ddos.is_ip_banned("127.0.0.1")
+
+
+def test_failed_auth_blacklists_pit():
+    """(ref: ddos_test.go TestWrongPassword)."""
+    from channeld_tpu.core import ddos
+    from channeld_tpu.core.auth import FixedPasswordAuthProvider, set_auth_provider
+
+    set_auth_provider(FixedPasswordAuthProvider("secret"))
+    global_settings.max_failed_auth_attempts = 2
+    try:
+        for i in range(2):
+            t = FakeTransport()
+            conn = add_connection(t, ConnectionType.CLIENT)
+            conn.on_bytes(
+                wire(
+                    MessageType.AUTH,
+                    control_pb2.AuthMessage(
+                        playerIdentifierToken="mallory", loginToken="wrong"
+                    ),
+                )
+            )
+            get_global_channel().tick_once(0)
+        assert ddos.is_pit_banned("mallory")
+        # A banned PIT is refused at the auth handler.
+        t = FakeTransport()
+        conn = add_connection(t, ConnectionType.CLIENT)
+        conn.on_bytes(
+            wire(
+                MessageType.AUTH,
+                control_pb2.AuthMessage(
+                    playerIdentifierToken="mallory", loginToken="secret"
+                ),
+            )
+        )
+        get_global_channel().tick_once(0)
+        assert conn.is_closing()
+    finally:
+        set_auth_provider(None)
+
+
+def test_handler_exception_does_not_kill_channel():
+    """One bad message must not stop the channel (code-review regression)."""
+    conn, t = auth_client()
+    gch = get_global_channel()
+    # SPATIAL creation currently routes to the spatial module; even if a
+    # handler raises, the channel must keep processing subsequent messages.
+    conn.on_bytes(
+        wire(
+            MessageType.CREATE_CHANNEL,
+            control_pb2.CreateChannelMessage(channelType=ChannelType.SPATIAL),
+        )
+    )
+    conn.on_bytes(
+        wire(
+            MessageType.LIST_CHANNEL,
+            control_pb2.ListChannelMessage(),
+        )
+    )
+    gch.tick_once(0)
+    conn.flush()
+    types = [m.msgType for m in sent_messages(t) if m.msgType == MessageType.LIST_CHANNEL]
+    assert types == [MessageType.LIST_CHANNEL]
+
+
+def test_banned_ip_refused_at_accept():
+    from channeld_tpu.core import ddos
+
+    ddos._ip_blacklist["127.0.0.1"] = 0.0
+    t = FakeTransport()
+    with pytest.raises(ConnectionRefusedError):
+        add_connection(t, ConnectionType.CLIENT)
+    assert t.closed
