@@ -529,6 +529,9 @@ def init_message_map() -> None:
         (MessageType.UNSUB_FROM_CHANNEL, handle_unsub_from_channel),
         (MessageType.CHANNEL_DATA_UPDATE, handle_channel_data_update),
         (MessageType.DISCONNECT, handle_disconnect),
+        # CREATE_SPATIAL_CHANNEL shares the CreateChannelMessage body and
+        # handler (ref: message.go:52-53).
+        (MessageType.CREATE_SPATIAL_CHANNEL, handle_create_channel),
     ]:
         MESSAGE_MAP[msg_type] = MessageMapEntry(MESSAGE_TEMPLATES[msg_type], handler)
     try:

@@ -1,0 +1,260 @@
+"""SpatialEngine: device-resident spatial decision state + tick driver.
+
+Host-side façade over the batched kernels in spatial_ops: fixed-capacity
+slot arrays with a free-list for dynamic entity membership (the device
+analog of the reference's entity maps), a query table for client AOI
+interests, and the fan-out subscription clock. One ``tick()`` performs
+the whole per-frame decision pass on device and returns host-consumable
+results (handover list, interest masks, due subscriptions).
+
+Dirty positions are staged host-side between ticks and shipped as one
+scatter per tick — the H2D traffic is O(moved entities), not O(capacity).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.logger import get_logger
+from .spatial_ops import (
+    AOI_BOX,
+    AOI_CONE,
+    AOI_NONE,
+    AOI_SPHERE,
+    GridSpec,
+    QuerySet,
+    spatial_step,
+)
+
+logger = get_logger("ops.engine")
+
+
+class SpatialEngine:
+    def __init__(
+        self,
+        grid: GridSpec,
+        entity_capacity: int = 1 << 17,
+        query_capacity: int = 1 << 12,
+        sub_capacity: int = 1 << 16,
+        max_handovers: int = 4096,
+    ):
+        self.grid = grid
+        self.entity_capacity = entity_capacity
+        self.query_capacity = query_capacity
+        self.sub_capacity = sub_capacity
+        self.max_handovers = max_handovers
+
+        # Host mirrors (numpy) + dirty staging.
+        self._positions = np.zeros((entity_capacity, 3), np.float32)
+        self._valid = np.zeros(entity_capacity, bool)
+        self._free = list(range(entity_capacity - 1, -1, -1))
+        self._slot_of_entity: dict[int, int] = {}
+        self._entity_of_slot = np.zeros(entity_capacity, np.uint32)
+        self._dirty_slots: set[int] = set()
+        self._seed_cells: dict[int, int] = {}  # slot -> forced prev cell
+
+        self._q_kind = np.zeros(query_capacity, np.int32)
+        self._q_center = np.zeros((query_capacity, 2), np.float32)
+        self._q_extent = np.zeros((query_capacity, 2), np.float32)
+        self._q_dir = np.zeros((query_capacity, 2), np.float32)
+        self._q_angle = np.zeros(query_capacity, np.float32)
+        self._q_free = list(range(query_capacity - 1, -1, -1))
+        self._q_of_conn: dict[int, int] = {}
+        self._queries_dirty = True
+
+        self._sub_last = np.zeros(sub_capacity, np.int32)
+        self._sub_interval = np.zeros(sub_capacity, np.int32)
+        self._sub_active = np.zeros(sub_capacity, bool)
+        self._sub_free = list(range(sub_capacity - 1, -1, -1))
+        self._subs_dirty = True
+
+        # Device state.
+        self._d_positions = jnp.asarray(self._positions)
+        self._d_valid = jnp.asarray(self._valid)
+        self._d_cell = jnp.full(entity_capacity, -1, jnp.int32)
+        self._d_queries: Optional[QuerySet] = None
+        self._d_sub_state = None
+
+        self._start = time.monotonic()
+        self.last_result: Optional[dict] = None
+
+    # ---- entity slots ----------------------------------------------------
+
+    def now_ms(self) -> int:
+        return int((time.monotonic() - self._start) * 1000)
+
+    def add_entity(self, entity_id: int, x: float, y: float, z: float) -> int:
+        slot = self._slot_of_entity.get(entity_id)
+        if slot is None:
+            if not self._free:
+                raise RuntimeError("entity capacity exhausted")
+            slot = self._free.pop()
+            self._slot_of_entity[entity_id] = slot
+            self._entity_of_slot[slot] = entity_id
+            # Fresh slot: clear any previous occupant's cell so reuse can't
+            # fabricate a crossing on the first tick.
+            self._seed_cells[slot] = -1
+        self._positions[slot] = (x, y, z)
+        self._valid[slot] = True
+        self._dirty_slots.add(slot)
+        return slot
+
+    def seed_cell(self, slot: int, cell: int) -> None:
+        """Set the device-side previous cell for a slot before its first
+        tick (used to seed a just-sighted entity's old position)."""
+        self._seed_cells[slot] = cell
+
+    def update_entity(self, entity_id: int, x: float, y: float, z: float) -> None:
+        slot = self._slot_of_entity.get(entity_id)
+        if slot is None:
+            self.add_entity(entity_id, x, y, z)
+            return
+        self._positions[slot] = (x, y, z)
+        self._dirty_slots.add(slot)
+
+    def remove_entity(self, entity_id: int) -> None:
+        slot = self._slot_of_entity.pop(entity_id, None)
+        if slot is None:
+            return
+        self._valid[slot] = False
+        self._dirty_slots.add(slot)
+        self._free.append(slot)
+
+    def entity_count(self) -> int:
+        return len(self._slot_of_entity)
+
+    def entity_id_of_slot(self, slot: int) -> int:
+        return int(self._entity_of_slot[slot])
+
+    # ---- queries ---------------------------------------------------------
+
+    def set_query(
+        self,
+        conn_id: int,
+        kind: int,
+        center_xz: tuple[float, float],
+        extent_xz: tuple[float, float] = (0.0, 0.0),
+        direction_xz: tuple[float, float] = (1.0, 0.0),
+        angle: float = 0.0,
+    ) -> None:
+        q = self._q_of_conn.get(conn_id)
+        if q is None:
+            if not self._q_free:
+                raise RuntimeError("query capacity exhausted")
+            q = self._q_free.pop()
+            self._q_of_conn[conn_id] = q
+        self._q_kind[q] = kind
+        self._q_center[q] = center_xz
+        self._q_extent[q] = extent_xz
+        norm = float(np.hypot(*direction_xz)) or 1.0
+        self._q_dir[q] = (direction_xz[0] / norm, direction_xz[1] / norm)
+        self._q_angle[q] = angle
+        self._queries_dirty = True
+
+    def remove_query(self, conn_id: int) -> None:
+        q = self._q_of_conn.pop(conn_id, None)
+        if q is not None:
+            self._q_kind[q] = AOI_NONE
+            self._q_free.append(q)
+            self._queries_dirty = True
+
+    def query_row_of_conn(self, conn_id: int) -> Optional[int]:
+        return self._q_of_conn.get(conn_id)
+
+    # ---- subscriptions ---------------------------------------------------
+
+    def add_subscription(self, interval_ms: int, first_due_ms: int = 0) -> int:
+        if not self._sub_free:
+            raise RuntimeError("subscription capacity exhausted")
+        s = self._sub_free.pop()
+        self._sub_last[s] = first_due_ms
+        self._sub_interval[s] = interval_ms
+        self._sub_active[s] = True
+        self._subs_dirty = True
+        return s
+
+    def remove_subscription(self, s: int) -> None:
+        self._sub_active[s] = False
+        self._sub_free.append(s)
+        self._subs_dirty = True
+
+    # ---- the tick --------------------------------------------------------
+
+    def _flush_host_state(self) -> None:
+        if self._dirty_slots:
+            idx = np.fromiter(self._dirty_slots, np.int32, len(self._dirty_slots))
+            self._d_positions = self._d_positions.at[idx].set(self._positions[idx])
+            self._d_valid = self._d_valid.at[idx].set(self._valid[idx])
+            self._dirty_slots.clear()
+        if self._seed_cells:
+            slots = np.fromiter(self._seed_cells.keys(), np.int32, len(self._seed_cells))
+            cells = np.fromiter(self._seed_cells.values(), np.int32, len(self._seed_cells))
+            self._d_cell = self._d_cell.at[slots].set(cells)
+            self._seed_cells.clear()
+        if self._d_queries is None or self._queries_dirty:
+            self._d_queries = QuerySet(
+                jnp.asarray(self._q_kind),
+                jnp.asarray(self._q_center),
+                jnp.asarray(self._q_extent),
+                jnp.asarray(self._q_dir),
+                jnp.asarray(self._q_angle),
+            )
+            self._queries_dirty = False
+        if self._d_sub_state is None or self._subs_dirty:
+            self._d_sub_state = (
+                jnp.asarray(self._sub_last),
+                jnp.asarray(self._sub_interval),
+                jnp.asarray(self._sub_active),
+            )
+            self._subs_dirty = False
+
+    def tick(self, now_ms: Optional[int] = None) -> dict:
+        """Run one device decision pass; returns numpy-backed results."""
+        if now_ms is None:
+            now_ms = self.now_ms()
+        self._flush_host_state()
+        out = spatial_step(
+            self.grid,
+            self._d_positions,
+            self._d_cell,
+            self._d_valid,
+            self._d_queries,
+            self._d_sub_state,
+            self.max_handovers,
+            jnp.int32(now_ms),
+        )
+        # Baseline for the next tick: crossings that overflowed the handover
+        # row budget keep their old cell so they are re-detected, not lost.
+        self._d_cell = out["committed_prev"]
+        self._d_sub_state = (
+            out["new_last_fanout_ms"],
+            self._d_sub_state[1],
+            self._d_sub_state[2],
+        )
+        self.last_result = out
+        return out
+
+    def handover_list(self, result: dict) -> list[tuple[int, int, int]]:
+        """[(entity_id, src_cell, dst_cell)] from a tick result."""
+        count = int(result["handover_count"])
+        rows = np.asarray(result["handovers"][: min(count, self.max_handovers)])
+        return [
+            (int(self._entity_of_slot[slot]), int(src), int(dst))
+            for slot, src, dst in rows
+            if slot >= 0
+        ]
+
+    def interested_cells(self, result: dict, conn_id: int) -> dict[int, int]:
+        """{cell_index: grid_distance} for one connection's query."""
+        q = self._q_of_conn.get(conn_id)
+        if q is None:
+            return {}
+        interest = np.asarray(result["interest"][q])
+        dist = np.asarray(result["dist"][q])
+        cells = np.nonzero(interest)[0]
+        return {int(c): int(dist[c]) for c in cells}

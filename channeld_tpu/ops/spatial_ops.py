@@ -1,0 +1,275 @@
+"""Batched spatial decision kernels (JAX).
+
+The TPU-native replacement for the reference's per-entity/per-subscriber
+CPU loops (ref: pkg/channeld/spatial.go:169-317 cell math + AOI sampling,
+data.go:175-291 fan-out due scan, spatial.go:612-626 handover detection).
+Everything here is shape-static, branch-free, and jit-compatible: state
+lives in fixed-capacity slot arrays with validity masks, and each tick
+recomputes assignment / interest / due decisions for *all* entities,
+queries, and subscriptions at once.
+
+Semantics notes vs the host path:
+- Cell assignment matches exactly: floor((p - offset) / cell), id =
+  start + x + z*cols, invalid (<0) outside the world.
+- AOI interest is computed as exact shape-vs-cell-rectangle overlap
+  instead of the host's half-grid-step point sampling — a strict
+  superset of the sampled cells for the same shape, with the same
+  ceil(dist / cell-diagonal) distance metric.
+- The fan-out due decision reproduces the (last, last+interval] window
+  advance: a due subscriber's window moves forward one interval.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class GridSpec(NamedTuple):
+    """Static grid geometry, baked into the compiled step."""
+
+    offset_x: float
+    offset_z: float
+    cell_w: float
+    cell_h: float
+    cols: int
+    rows: int
+
+    @property
+    def num_cells(self) -> int:
+        return self.cols * self.rows
+
+    @property
+    def diagonal(self) -> float:
+        return float((self.cell_w**2 + self.cell_h**2) ** 0.5)
+
+
+# ---- cell assignment ------------------------------------------------------
+
+
+def assign_cells(grid: GridSpec, positions: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
+    """positions f32[N,3] -> cell index i32[N]; -1 for invalid/outside.
+
+    (ref: spatial.go:169-180 GetChannelIdWithOffset, vectorized.)
+    """
+    gx = jnp.floor((positions[:, 0] - grid.offset_x) / grid.cell_w).astype(jnp.int32)
+    gz = jnp.floor((positions[:, 2] - grid.offset_z) / grid.cell_h).astype(jnp.int32)
+    inside = (gx >= 0) & (gx < grid.cols) & (gz >= 0) & (gz < grid.rows) & valid
+    return jnp.where(inside, gx + gz * grid.cols, -1)
+
+
+# ---- handover detection ---------------------------------------------------
+
+
+def detect_handovers(old_cell: jnp.ndarray, new_cell: jnp.ndarray) -> jnp.ndarray:
+    """bool[N]: entity crossed a cell boundary this tick
+    (ref: spatial.go:613-626 src != dst check, batched)."""
+    return (old_cell >= 0) & (new_cell >= 0) & (old_cell != new_cell)
+
+
+def compact_handovers(
+    handover_mask: jnp.ndarray,
+    old_cell: jnp.ndarray,
+    new_cell: jnp.ndarray,
+    max_out: int,
+):
+    """Pack (entity_slot, src_cell, dst_cell) rows for up to ``max_out``
+    crossings into a fixed-shape output (count, rows i32[max_out,3]).
+
+    Fixed shapes keep the step recompile-free; overflow beyond max_out is
+    reported via count so the host can fall back next tick.
+    """
+    n = handover_mask.shape[0]
+    max_out = min(max_out, n)
+    count = jnp.sum(handover_mask, dtype=jnp.int32)
+    # Stable order: sort puts handover slots first.
+    order = jnp.argsort(~handover_mask)  # False<True: handovers first
+    idx = order[:max_out]
+    rows = jnp.stack(
+        [idx.astype(jnp.int32), old_cell[idx], new_cell[idx]], axis=1
+    )
+    row_valid = jnp.arange(max_out) < jnp.minimum(count, max_out)
+    rows = jnp.where(row_valid[:, None], rows, -1)
+    # Which entities actually made it into the rows: rank within the sort.
+    rank = jnp.zeros(n, jnp.int32).at[order].set(jnp.arange(n, dtype=jnp.int32))
+    reported = handover_mask & (rank < max_out)
+    return count, rows, reported
+
+
+# ---- per-cell occupancy ---------------------------------------------------
+
+
+def cell_counts(cell_of: jnp.ndarray, num_cells: int) -> jnp.ndarray:
+    """Entity count per cell, i32[num_cells] (segment-sum)."""
+    valid = cell_of >= 0
+    return jnp.zeros(num_cells, jnp.int32).at[
+        jnp.where(valid, cell_of, 0)
+    ].add(valid.astype(jnp.int32))
+
+
+# ---- AOI: query x cell interest masks ------------------------------------
+
+AOI_NONE = 0
+AOI_SPHERE = 1
+AOI_BOX = 2
+AOI_CONE = 3
+
+
+class QuerySet(NamedTuple):
+    """SoA batch of client interest queries (ref: channeld.proto
+    SpatialInterestQuery; one active shape per query)."""
+
+    kind: jnp.ndarray  # i32[Q] in {NONE, SPHERE, BOX, CONE}
+    center: jnp.ndarray  # f32[Q,2] (x,z)
+    extent: jnp.ndarray  # f32[Q,2] box half-extent (x,z); radius in [:,0] for sphere/cone
+    direction: jnp.ndarray  # f32[Q,2] cone direction (x,z), normalized
+    angle: jnp.ndarray  # f32[Q] cone half-angle, radians
+
+
+def _cell_geometry(grid: GridSpec):
+    """Centers f32[C,2] and half-sizes of every cell."""
+    c = jnp.arange(grid.num_cells, dtype=jnp.int32)
+    cx = grid.offset_x + (c % grid.cols + 0.5) * grid.cell_w
+    cz = grid.offset_z + (c // grid.cols + 0.5) * grid.cell_h
+    return jnp.stack([cx, cz], axis=1)
+
+
+def aoi_masks(grid: GridSpec, queries: QuerySet):
+    """Interest of every query in every cell.
+
+    Returns (interest bool[Q,C], dist i32[Q,C]) where dist is the
+    ceil(center-to-sample / cell-diagonal) damping distance, matching the
+    host path's metric (ref: spatial.go:182-317).
+    """
+    centers = _cell_geometry(grid)  # [C,2]
+    half = jnp.array([grid.cell_w * 0.5, grid.cell_h * 0.5])
+
+    # Distance from each query center to each cell rectangle (clamped).
+    delta = jnp.abs(queries.center[:, None, :] - centers[None, :, :])  # [Q,C,2]
+    gap = jnp.maximum(delta - half[None, None, :], 0.0)
+    rect_dist = jnp.sqrt(jnp.sum(gap * gap, axis=-1))  # [Q,C]
+    center_dist = jnp.sqrt(jnp.sum((queries.center[:, None, :] - centers) ** 2, axis=-1))
+
+    radius = queries.extent[:, 0:1]  # [Q,1]
+
+    # Sphere: shape overlaps the cell rect.
+    sphere_hit = rect_dist <= radius
+
+    # Box: axis-aligned overlap test.
+    box_hit = jnp.all(delta <= (queries.extent[:, None, :] + half[None, None, :]), axis=-1)
+
+    # Cone: within radius AND the cell center direction within the half-angle
+    # (cell containing the apex always hits).
+    to_cell = centers[None, :, :] - queries.center[:, None, :]  # [Q,C,2]
+    to_len = jnp.maximum(jnp.sqrt(jnp.sum(to_cell * to_cell, axis=-1)), 1e-9)
+    cosine = jnp.sum(to_cell * queries.direction[:, None, :], axis=-1) / to_len
+    in_angle = cosine >= jnp.cos(queries.angle)[:, None]
+    apex_cell = rect_dist <= 0.0
+    cone_hit = (rect_dist <= radius) & (in_angle | apex_cell)
+
+    hit = jnp.where(
+        queries.kind[:, None] == AOI_SPHERE,
+        sphere_hit,
+        jnp.where(
+            queries.kind[:, None] == AOI_BOX,
+            box_hit,
+            jnp.where(queries.kind[:, None] == AOI_CONE, cone_hit, False),
+        ),
+    )
+    diag = grid.diagonal
+    dist = jnp.ceil(center_dist / diag).astype(jnp.int32)
+    # The query's own cell is distance 0 (ref: result[centerChId] = 0).
+    dist = jnp.where(rect_dist <= 0.0, 0, dist)
+    return hit, dist
+
+
+def damping_intervals_ms(
+    dist: jnp.ndarray,
+    interest: jnp.ndarray,
+    tiers: jnp.ndarray,
+    tier_intervals: jnp.ndarray,
+    default_interval: int,
+) -> jnp.ndarray:
+    """Map grid distance -> fan-out interval per (query, cell)
+    (ref: message_spatial.go:10-38 damping table).
+
+    ``tiers`` i32[T] ascending max-distances, ``tier_intervals`` i32[T].
+    Beyond the last tier the default interval applies.
+    """
+    # Index of the first tier whose max_distance >= dist.
+    idx = jnp.searchsorted(tiers, dist.ravel(), side="left").reshape(dist.shape)
+    in_table = idx < tiers.shape[0]
+    interval = jnp.where(
+        in_table, tier_intervals[jnp.minimum(idx, tiers.shape[0] - 1)], default_interval
+    )
+    return jnp.where(interest, interval, 0)
+
+
+# ---- fan-out due decision -------------------------------------------------
+
+
+def fanout_due(
+    now_ms: jnp.ndarray,
+    last_fanout_ms: jnp.ndarray,
+    interval_ms: jnp.ndarray,
+    active: jnp.ndarray,
+):
+    """Which subscriptions are due, and their advanced window starts.
+
+    Times are int32 milliseconds since engine start (int64 is emulated on
+    TPU; i32 ms wraps after ~24 days, far beyond a session). Reproduces
+    tick_data's window advance (ref: data.go:252-258): a due sub's
+    last-fan-out moves to last+interval (not to ``now``), keeping late
+    updates deliverable. Returns (due bool[S], new_last i32[S]).
+    """
+    next_ms = last_fanout_ms + interval_ms
+    due = active & (now_ms >= next_ms)
+    return due, jnp.where(due, next_ms, last_fanout_ms)
+
+
+# ---- the fused per-tick step ---------------------------------------------
+
+
+@partial(jax.jit, static_argnums=(0, 6), donate_argnums=(2,))
+def spatial_step(
+    grid: GridSpec,
+    positions: jnp.ndarray,  # f32[N,3]
+    prev_cell: jnp.ndarray,  # i32[N] (donated; replaced by new assignment)
+    valid: jnp.ndarray,  # bool[N]
+    queries: QuerySet,
+    sub_state: tuple,  # (last_fanout_ms i32[S], interval_ms i32[S], active bool[S])
+    max_handovers: int,
+    now_ms,
+):
+    """One decision tick, fully on device: cell assignment + handover
+    detection/compaction + per-cell occupancy + AOI interest + fan-out
+    due mask. Returns everything the host needs to route messages."""
+    cell_of = assign_cells(grid, positions, valid)
+    handover_mask = detect_handovers(prev_cell, cell_of)
+    ho_count, ho_rows, reported = compact_handovers(
+        handover_mask, prev_cell, cell_of, max_handovers
+    )
+    # Crossings that overflowed the row budget keep their *old* cell as the
+    # next tick's baseline, so they are re-detected instead of lost.
+    committed_prev = jnp.where(handover_mask & ~reported, prev_cell, cell_of)
+    counts = cell_counts(cell_of, grid.num_cells)
+    interest, dist = aoi_masks(grid, queries)
+    last_ms, interval_ms, active = sub_state
+    due, new_last = fanout_due(now_ms, last_ms, interval_ms, active)
+    return {
+        "cell_of": cell_of,
+        "committed_prev": committed_prev,
+        "handover_count": ho_count,
+        "handovers": ho_rows,
+        "cell_counts": counts,
+        "interest": interest,
+        "dist": dist,
+        "due": due,
+        # Bit-packed due mask: 8x less D2H for the per-tick host readback
+        # (unpack host-side with np.unpackbits).
+        "due_packed": jnp.packbits(due),
+        "new_last_fanout_ms": new_last,
+    }

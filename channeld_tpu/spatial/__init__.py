@@ -15,6 +15,8 @@ from .controller import (
     set_spatial_controller,
 )
 from .entity import EntityGroup, FlatEntityGroupController
+from .grid import StaticGrid2DSpatialController
+from .tpu_controller import TPUSpatialController
 
 __all__ = [
     "SpatialController",
@@ -25,4 +27,6 @@ __all__ = [
     "set_spatial_controller",
     "EntityGroup",
     "FlatEntityGroupController",
+    "StaticGrid2DSpatialController",
+    "TPUSpatialController",
 ]

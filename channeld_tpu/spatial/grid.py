@@ -1,0 +1,572 @@
+"""StaticGrid2D spatial controller — host-semantics implementation.
+
+Capability parity with the reference controller
+(ref: pkg/channeld/spatial.go:89-902): the world is GridCols x GridRows
+cells on the XZ plane; channelId = spatial_start + x + y*cols; each
+spatial server owns a ServerCols x ServerRows block plus an interest
+border of cells it subscribes to; AOI queries (spots/box/sphere/cone)
+sample cells at half-grid steps and return {channelId: grid-distance};
+``notify`` orchestrates cross-cell (and cross-server) entity handover.
+
+This module is the *semantic reference* path. The TPU decision plane
+(channeld_tpu.ops / tpu_controller.py) computes cell assignment, AOI
+masks and handover detection as batched device arrays and must agree
+with this implementation — the geometry tests pin both.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional
+
+from ..core.settings import global_settings
+from ..core.types import ChannelType, ConnectionType, MessageType
+from ..protocol import control_pb2, spatial_pb2
+from ..utils.anyutil import pack_any
+from ..utils.logger import get_logger
+from .controller import SpatialInfo, register_spatial_controller_type
+
+logger = get_logger("spatial.grid")
+
+# Y bounds of a region (the grid is 2D; regions span all heights)
+# (ref: spatial.go MinY/MaxY).
+MIN_Y = -3.40282347e38 / 2
+MAX_Y = 3.40282347e38 / 2
+
+
+def _dist_2d(ax: float, az: float, bx: float, bz: float) -> float:
+    return math.hypot(ax - bx, az - bz)
+
+
+class StaticGrid2DSpatialController:
+    """(ref: spatial.go:93-124)."""
+
+    def __init__(self):
+        self.grid_width = 0.0
+        self.grid_height = 0.0
+        self.grid_cols = 0
+        self.grid_rows = 0
+        self.world_offset_x = 0.0
+        self.world_offset_z = 0.0
+        self.server_cols = 0
+        self.server_rows = 0
+        self.server_interest_border_size = 0
+        self.server_connections: list = []
+        self._grid_size = 0.0
+
+    # ---- config ----------------------------------------------------------
+
+    def load_config(self, config: dict) -> None:
+        self.grid_width = float(config.get("GridWidth", 0))
+        self.grid_height = float(config.get("GridHeight", 0))
+        self.grid_cols = int(config.get("GridCols", 0))
+        self.grid_rows = int(config.get("GridRows", 0))
+        self.world_offset_x = float(config.get("WorldOffsetX", 0))
+        self.world_offset_z = float(config.get("WorldOffsetZ", 0))
+        self.server_cols = int(config.get("ServerCols", 0))
+        self.server_rows = int(config.get("ServerRows", 0))
+        self.server_interest_border_size = int(
+            config.get("ServerInterestBorderSize", 0)
+        )
+        if self.grid_width <= 0 or self.grid_height <= 0:
+            raise ValueError("GridWidth and GridHeight should be positive")
+        if self.grid_cols <= 0 or self.grid_rows <= 0:
+            raise ValueError("GridCols and GridRows should be positive")
+        if self.server_cols <= 0 or self.server_rows <= 0:
+            raise ValueError("ServerCols and ServerRows should be positive")
+
+    # ---- geometry --------------------------------------------------------
+
+    def world_width(self) -> float:
+        return self.grid_width * self.grid_cols
+
+    def world_height(self) -> float:
+        return self.grid_height * self.grid_rows
+
+    def grid_size(self) -> float:
+        """Cell diagonal, the unit of AOI distance (ref: spatial.go:137-142)."""
+        if self._grid_size == 0 and self.grid_width > 0 and self.grid_height > 0:
+            self._grid_size = math.hypot(self.grid_width, self.grid_height)
+        return self._grid_size
+
+    def get_channel_id(self, info: SpatialInfo) -> int:
+        return self.get_channel_id_with_offset(
+            info, self.world_offset_x, self.world_offset_z
+        )
+
+    def get_channel_id_no_offset(self, info: SpatialInfo) -> int:
+        return self.get_channel_id_with_offset(info, 0.0, 0.0)
+
+    def get_channel_id_with_offset(
+        self, info: SpatialInfo, offset_x: float, offset_z: float
+    ) -> int:
+        """channelId = start + floor((x-ox)/w) + floor((z-oz)/h)*cols
+        (ref: spatial.go:169-180). Raises ValueError outside the world."""
+        gx = math.floor((info.x - offset_x) / self.grid_width)
+        if gx < 0 or gx >= self.grid_cols:
+            raise ValueError(f"gridX={gx} out of [0,{self.grid_cols}) for X={info.x}")
+        gz = math.floor((info.z - offset_z) / self.grid_height)
+        if gz < 0 or gz >= self.grid_rows:
+            raise ValueError(f"gridY={gz} out of [0,{self.grid_rows}) for Z={info.z}")
+        return global_settings.spatial_channel_id_start + gx + gz * self.grid_cols
+
+    # ---- AOI queries -----------------------------------------------------
+
+    def query_channel_ids(self, query: spatial_pb2.SpatialInterestQuery) -> dict[int, int]:
+        """{channelId: distance in grid-diagonal units}; 0 = nearest
+        (ref: spatial.go:182-317)."""
+        if query is None:
+            raise ValueError("query is nil")
+        result: dict[int, int] = {}
+
+        if query.HasField("spotsAOI"):
+            for i, spot in enumerate(query.spotsAOI.spots):
+                try:
+                    ch_id = self.get_channel_id(SpatialInfo(spot.x, spot.y, spot.z))
+                except ValueError:
+                    continue
+                if i < len(query.spotsAOI.dists):
+                    result[ch_id] = query.spotsAOI.dists[i]
+                else:
+                    result[ch_id] = 0
+
+        if query.HasField("boxAOI"):
+            box = query.boxAOI
+            cx, cz = box.center.x, box.center.z
+            step_z = min(box.extent.z, self.grid_height) * 0.5
+            if step_z <= 0:
+                raise ValueError(f"invalid box extentZ={box.extent.z}")
+            step_x = min(box.extent.x, self.grid_width) * 0.5
+            if step_x <= 0:
+                raise ValueError(f"invalid box extentX={box.extent.x}")
+            z = cz - box.extent.z
+            while z <= cz + box.extent.z:
+                x = cx - box.extent.x
+                while x <= cx + box.extent.x:
+                    self._add_sample(result, cx, cz, x, z)
+                    x += step_x
+                z += step_z
+            result[self.get_channel_id(SpatialInfo(cx, 0, cz))] = 0
+
+        if query.HasField("sphereAOI"):
+            r = query.sphereAOI.radius
+            cx, cz = query.sphereAOI.center.x, query.sphereAOI.center.z
+            step_z = min(r, self.grid_height) * 0.5
+            step_x = min(r, self.grid_width) * 0.5
+            if step_z <= 0 or step_x <= 0:
+                raise ValueError(f"invalid radius={r}")
+            z = cz - r
+            while z <= cz + r:
+                x = cx - r
+                while x <= cx + r:
+                    if (x - cx) ** 2 + (z - cz) ** 2 <= r * r:
+                        self._add_sample(result, cx, cz, x, z)
+                    x += step_x
+                z += step_z
+            result[self.get_channel_id(SpatialInfo(cx, 0, cz))] = 0
+
+        if query.HasField("coneAOI"):
+            cone = query.coneAOI
+            r = cone.radius
+            cx, cz = cone.center.x, cone.center.z
+            dx, dz = cone.direction.x, cone.direction.z
+            dlen = math.hypot(dx, dz)
+            if dlen > 0:
+                dx, dz = dx / dlen, dz / dlen
+            step_z = min(r, self.grid_height) * 0.5
+            step_x = min(r, self.grid_width) * 0.5
+            if step_z <= 0 or step_x <= 0:
+                raise ValueError(f"invalid radius={r}")
+            cos_angle = math.cos(cone.angle)
+            z = max(self.world_offset_z, cz - r)
+            z_end = min(self.world_offset_z + self.world_height(), cz + r)
+            x_start = max(self.world_offset_x, cx - r)
+            x_end = min(self.world_offset_x + self.world_width(), cx + r)
+            while z <= z_end:
+                x = x_start
+                while x <= x_end:
+                    if (x - cx) ** 2 + (z - cz) ** 2 <= r * r:
+                        ex, ez = x - cx, z - cz
+                        elen = math.hypot(ex, ez)
+                        if elen > 0:
+                            ex, ez = ex / elen, ez / elen
+                        if ex * dx + ez * dz >= cos_angle:
+                            self._add_sample(result, cx, cz, x, z)
+                    x += step_x
+                z += step_z
+            result[self.get_channel_id(SpatialInfo(cx, 0, cz))] = 0
+
+        return result
+
+    def _add_sample(self, result: dict, cx: float, cz: float, x: float, z: float) -> None:
+        try:
+            ch_id = self.get_channel_id(SpatialInfo(x, 0, z))
+        except ValueError:
+            return
+        result[ch_id] = int(math.ceil(_dist_2d(cx, cz, x, z) / self.grid_size()))
+
+    # ---- regions / adjacency --------------------------------------------
+
+    def _server_grid_cols(self) -> int:
+        return -(-self.grid_cols // self.server_cols)  # ceil div
+
+    def _server_grid_rows(self) -> int:
+        return -(-self.grid_rows // self.server_rows)
+
+    def get_regions(self) -> list[spatial_pb2.SpatialRegion]:
+        """(ref: spatial.go:319-356)."""
+        sgc, sgr = self._server_grid_cols(), self._server_grid_rows()
+        regions = []
+        for y in range(self.grid_rows):
+            for x in range(self.grid_cols):
+                index = x + y * self.grid_cols
+                regions.append(
+                    spatial_pb2.SpatialRegion(
+                        min=spatial_pb2.SpatialInfo(
+                            x=self.world_offset_x + self.grid_width * x,
+                            y=MIN_Y,
+                            z=self.world_offset_z + self.grid_height * y,
+                        ),
+                        max=spatial_pb2.SpatialInfo(
+                            x=self.world_offset_x + self.grid_width * (x + 1),
+                            y=MAX_Y,
+                            z=self.world_offset_z + self.grid_height * (y + 1),
+                        ),
+                        channelId=global_settings.spatial_channel_id_start + index,
+                        serverIndex=(x // sgc) + (y // sgr) * self.server_cols,
+                    )
+                )
+        return regions
+
+    def get_adjacent_channels(self, spatial_channel_id: int) -> list[int]:
+        """3x3 neighborhood minus self (ref: spatial.go:358-381)."""
+        index = spatial_channel_id - global_settings.spatial_channel_id_start
+        gx, gy = index % self.grid_cols, index // self.grid_cols
+        out = []
+        for y in range(gy - 1, gy + 2):
+            if y < 0 or y >= self.grid_rows:
+                continue
+            for x in range(gx - 1, gx + 2):
+                if x < 0 or x >= self.grid_cols or (x == gx and y == gy):
+                    continue
+                out.append(
+                    global_settings.spatial_channel_id_start + x + y * self.grid_cols
+                )
+        return out
+
+    # ---- server lifecycle ------------------------------------------------
+
+    def _init_server_connections(self) -> None:
+        if not self.server_connections:
+            self.server_connections = [None] * (self.server_cols * self.server_rows)
+
+    def _next_server_index(self) -> int:
+        for i, conn in enumerate(self.server_connections):
+            if conn is None or conn.is_closing():
+                return i
+        return len(self.server_connections)
+
+    def create_channels(self, ctx) -> list:
+        """Allocate one server's authority block of spatial channels
+        (ref: spatial.go:387-479)."""
+        from ..core.channel import create_channel_with_id
+        from ..core.channel import get_global_channel
+        from ..core.data import unwrap_update_any
+        from ..core.message import MessageContext
+
+        self._init_server_connections()
+        server_index = self._next_server_index()
+        n_servers = self.server_cols * self.server_rows
+        if server_index >= n_servers:
+            raise RuntimeError(
+                f"all {self.grid_cols * self.grid_rows} grids are already "
+                f"allocated to {n_servers} servers"
+            )
+        msg = ctx.msg
+        if not isinstance(msg, control_pb2.CreateChannelMessage):
+            raise TypeError("ctx.msg is not a CreateChannelMessage")
+
+        sgc, sgr = self._server_grid_cols(), self._server_grid_rows()
+        sx, sy = server_index % self.server_cols, server_index // self.server_cols
+        channel_ids = []
+        for y in range(sgr):
+            for x in range(sgc):
+                info = SpatialInfo(
+                    x=(sx * sgc + x) * self.grid_width,
+                    z=(sy * sgr + y) * self.grid_height,
+                )
+                channel_ids.append(self.get_channel_id_no_offset(info))
+
+        channels = []
+        for channel_id in channel_ids:
+            ch = create_channel_with_id(channel_id, ChannelType.SPATIAL, ctx.connection)
+            if msg.HasField("data"):
+                ch.init_data(unwrap_update_any(msg.data), msg.mergeOptions)
+            else:
+                ch.init_data(None, msg.mergeOptions)
+            channels.append(ch)
+
+        self.server_connections[server_index] = ctx.connection
+        server_index = self._next_server_index()
+        if server_index == n_servers:
+            # Everyone is in: wire the interest borders, then tell all the
+            # spatial servers (and the master server) the world is ready.
+            for i in range(n_servers):
+                self._sub_to_adjacent_channels(i, sgc, sgr, msg.subOptions)
+            ready = spatial_pb2.SpatialChannelsReadyMessage(
+                serverIndex=server_index, serverCount=n_servers
+            )
+            for conn in self.server_connections:
+                conn.send(
+                    MessageContext(
+                        msg_type=MessageType.SPATIAL_CHANNELS_READY, msg=ready
+                    )
+                )
+            gch = get_global_channel()
+            if gch is not None and gch.get_owner() is not None:
+                gch.get_owner().send(
+                    MessageContext(
+                        msg_type=MessageType.SPATIAL_CHANNELS_READY, msg=ready
+                    )
+                )
+        return channels
+
+    def _sub_to_adjacent_channels(
+        self, server_index: int, sgc: int, sgr: int, sub_options
+    ) -> None:
+        """Subscribe a server to the interest border around its authority
+        block (ref: spatial.go:481-590)."""
+        if self.server_interest_border_size == 0:
+            return
+        from ..core.channel import get_channel
+        from ..core.subscription import subscribe_to_channel
+        from ..core.subscription_messages import send_subscribed
+
+        conn = self.server_connections[server_index]
+        sx, sy = server_index % self.server_cols, server_index // self.server_cols
+        border = self.server_interest_border_size
+
+        def sub_cell(grid_x_units: float, grid_z_units: float) -> None:
+            info = SpatialInfo(
+                x=grid_x_units * self.grid_width, z=grid_z_units * self.grid_height
+            )
+            channel_id = self.get_channel_id_no_offset(info)
+            ch = get_channel(channel_id)
+            if ch is None:
+                raise RuntimeError(f"border channel {channel_id} doesn't exist")
+            cs, should_send = subscribe_to_channel(conn, ch, sub_options)
+            if should_send:
+                send_subscribed(conn, ch, conn, 0, cs.options)
+
+        if sx > 0:  # cells to the left of the block
+            for y in range(sgr):
+                for x in range(1, border + 1):
+                    sub_cell(sx * sgc - x, sy * sgr + y)
+        if sx < self.server_cols - 1:  # right
+            for y in range(sgr):
+                for x in range(border):
+                    sub_cell((sx + 1) * sgc + x, sy * sgr + y)
+        if sy > 0:  # below
+            for y in range(1, border + 1):
+                for x in range(sgc):
+                    sub_cell(sx * sgc + x, sy * sgr - y)
+        if sy < self.server_rows - 1:  # above
+            for y in range(border):
+                for x in range(sgc):
+                    sub_cell(sx * sgc + x, (sy + 1) * sgr + y)
+
+    def tick(self) -> None:
+        """Reap closed server connections (ref: spatial.go:884-893)."""
+        self._init_server_connections()
+        for i, conn in enumerate(self.server_connections):
+            if conn is not None and conn.is_closing():
+                self.server_connections[i] = None
+                logger.info("reset spatial server connection %d", i)
+
+    # ---- handover --------------------------------------------------------
+
+    def notify(
+        self,
+        old_info: SpatialInfo,
+        new_info: SpatialInfo,
+        handover_data_provider: Callable[[int, int], Optional[int]],
+    ) -> None:
+        """Cross-cell entity migration (ref: spatial.go:612-858).
+
+        ``handover_data_provider(src, dst)`` returns the id of the entity
+        whose movement triggered the notification (the reference passes an
+        out-pointer; we return it).
+        """
+        from ..core.channel import get_channel
+        from ..core.data import reflect_channel_data_message
+        from ..core.message import MessageContext
+        from ..core.subscription import subscribe_to_channel
+        from ..core.subscription_messages import send_subscribed, send_unsubscribed
+        from ..core.types import ChannelDataAccess
+        from ..core.subscription import unsubscribe_from_channel
+
+        try:
+            src_channel_id = self.get_channel_id(old_info)
+            dst_channel_id = self.get_channel_id(new_info)
+        except ValueError as e:
+            logger.error("failed to compute handover channel ids: %s", e)
+            return
+        if src_channel_id == dst_channel_id:
+            return
+
+        src_channel = get_channel(src_channel_id)
+        dst_channel = get_channel(dst_channel_id)
+        if src_channel is None or dst_channel is None:
+            logger.error(
+                "handover impossible: channel missing (src=%s dst=%s)",
+                src_channel_id, dst_channel_id,
+            )
+            return
+
+        handover_entity_id = handover_data_provider(src_channel_id, dst_channel_id)
+        if handover_entity_id is None:
+            return
+
+        entity_channel = get_channel(handover_entity_id)
+        if entity_channel is None:
+            logger.warning(
+                "handover skipped: entity channel %d doesn't exist", handover_entity_id
+            )
+            return
+        handover_entities = entity_channel.get_handover_entities(handover_entity_id)
+        if not handover_entities:
+            return  # a member is locked, or nothing to move
+
+        # Step 1: cross-server — swap entity-channel ownership first so the
+        # src server's residual updates are ignored (prevents handover loops).
+        if not src_channel.is_same_owner(dst_channel):
+            for entity_id in handover_entities:
+                entity_ch = get_channel(entity_id)
+                if entity_ch is None:
+                    continue
+                owner = src_channel.get_owner()
+                if (
+                    owner is not None
+                    and not owner.is_closing()
+                    and not owner.has_interest_in(dst_channel_id)
+                ):
+                    try:
+                        unsubscribe_from_channel(owner, entity_ch)
+                        send_unsubscribed(owner, entity_ch, None, 0)
+                    except KeyError:
+                        pass
+                entity_ch.set_owner(dst_channel.get_owner())
+
+        # Step 2: move the entities between the spatial channels' data,
+        # each inside its own channel's execution context.
+        def _remove(ch):
+            data_msg = ch.get_data_message()
+            remover = getattr(data_msg, "remove_entity", None)
+            if remover is None:
+                ch.logger.warning("spatial data can't remove entities")
+                return
+            for entity_id in handover_entities:
+                remover(entity_id)
+
+        def _add(ch):
+            data_msg = ch.get_data_message()
+            adder = getattr(data_msg, "add_entity", None)
+            if adder is None:
+                ch.logger.warning("spatial data can't add entities")
+                return
+            for entity_id, entity_data in handover_entities.items():
+                if entity_data is not None:
+                    adder(entity_id, entity_data)
+
+        src_channel.execute(_remove)
+        dst_channel.execute(_add)
+
+        # Step 3: identifier-only handover payload for src-side connections.
+        spatial_data_msg = reflect_channel_data_message(ChannelType.SPATIAL)
+        if spatial_data_msg is None:
+            logger.error("no SPATIAL channel data type registered for handover")
+            return
+        initializer = getattr(spatial_data_msg, "init_data", None)
+        if callable(initializer):
+            initializer()
+        for entity_id, entity_data in handover_entities.items():
+            if entity_data is None:
+                continue
+            merger = getattr(entity_data, "merge_to", None)
+            if callable(merger):
+                merger(spatial_data_msg, False)
+            else:
+                logger.warning("entity %d data has no merge_to()", entity_id)
+
+        context_conn_id = src_channel.latest_data_update_conn_id
+        base_msg = spatial_pb2.ChannelDataHandoverMessage(
+            srcChannelId=src_channel_id,
+            dstChannelId=dst_channel_id,
+            contextConnId=context_conn_id,
+            data=pack_any(spatial_data_msg),
+        )
+
+        src_conns = src_channel.get_all_connections()
+        dst_conns = dst_channel.get_all_connections()
+
+        # Step 4-1: src-only connections get the identifier-only payload.
+        for conn in src_conns - dst_conns:
+            conn.send(
+                MessageContext(
+                    msg_type=MessageType.CHANNEL_DATA_HANDOVER,
+                    msg=base_msg,
+                    channel_id=dst_channel_id,
+                )
+            )
+
+        # Step 4-2: dst connections are auto-subscribed to the entity
+        # channels (WRITE for the new owner) and receive full entity data
+        # when newly subscribed.
+        for conn in dst_conns:
+            handover_data_msg = type(spatial_data_msg)()
+            initializer = getattr(handover_data_msg, "init_data", None)
+            if callable(initializer):
+                initializer()
+            for entity_id, entity_data in handover_entities.items():
+                entity_ch = get_channel(entity_id)
+                if entity_ch is None or entity_data is None:
+                    continue
+                sub_options = control_pb2.ChannelSubscriptionOptions(
+                    skipSelfUpdateFanOut=True,
+                    # Entity data rides in the handover message itself.
+                    skipFirstFanOut=True,
+                    dataAccess=(
+                        ChannelDataAccess.WRITE_ACCESS
+                        if conn is entity_ch.get_owner()
+                        else ChannelDataAccess.READ_ACCESS
+                    ),
+                )
+                cs, should_send = subscribe_to_channel(conn, entity_ch, sub_options)
+                if cs is None:
+                    continue
+                if should_send:
+                    send_subscribed(conn, entity_ch, conn, 0, cs.options)
+                merger = getattr(entity_data, "merge_to", None)
+                if callable(merger):
+                    # Full state for new subscribers.
+                    merger(handover_data_msg, should_send)
+            conn.send(
+                MessageContext(
+                    msg_type=MessageType.CHANNEL_DATA_HANDOVER,
+                    msg=spatial_pb2.ChannelDataHandoverMessage(
+                        srcChannelId=src_channel_id,
+                        dstChannelId=dst_channel_id,
+                        contextConnId=context_conn_id,
+                        data=pack_any(handover_data_msg),
+                    ),
+                    channel_id=dst_channel_id,
+                )
+            )
+
+
+register_spatial_controller_type(
+    "Static2DSpatialController", StaticGrid2DSpatialController
+)
+register_spatial_controller_type(
+    "StaticGrid2DSpatialController", StaticGrid2DSpatialController
+)

@@ -1,0 +1,135 @@
+"""TPUSpatialController: the device-backed spatial controller.
+
+Config-selected exactly like the static host controller
+(ref: spatial.go:65-69 — the SpatialController interface is the plugin
+boundary), so ``spatial_static_*.json`` configs choose host vs TPU
+without touching the protocol path:
+
+    {"SpatialControllerType": "TPUSpatialController", "Config": {...}}
+
+Inherits all control-plane behavior (channel creation, regions, border
+subscriptions, AOI query host semantics) from StaticGrid2DSpatialController
+and moves the per-tick *decision plane* onto the device:
+
+- ``notify`` no longer compares cells per entity on the host; it records
+  the entity's new position in the SpatialEngine slot arrays.
+- Once per GLOBAL-channel tick, one batched device step recomputes cell
+  assignment for every entity and compacts boundary crossings; each
+  crossing then runs the exact same handover orchestration as the host
+  path (owner swap -> entity-table move -> handover fan-out).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ..core.settings import global_settings
+from ..utils.logger import get_logger
+from .controller import SpatialInfo, register_spatial_controller_type
+from .grid import StaticGrid2DSpatialController
+
+logger = get_logger("spatial.tpu")
+
+
+class TPUSpatialController(StaticGrid2DSpatialController):
+    def __init__(self):
+        super().__init__()
+        self.engine = None
+        # entity id -> provider returning the notifying entity id, captured
+        # from the most recent position update (used at batch-detect time).
+        self._providers: dict[int, Callable[[int, int], Optional[int]]] = {}
+        self._last_positions: dict[int, SpatialInfo] = {}
+
+    def load_config(self, config: dict) -> None:
+        super().load_config(config)
+        from ..core import events
+        from ..ops.engine import SpatialEngine
+        from ..ops.spatial_ops import GridSpec
+
+        def _on_channel_removed(channel_id: int) -> None:
+            if channel_id >= global_settings.entity_channel_id_start:
+                self.untrack_entity(channel_id)
+
+        events.channel_removed.listen_for(self, _on_channel_removed)
+
+        self.engine = SpatialEngine(
+            GridSpec(
+                offset_x=self.world_offset_x,
+                offset_z=self.world_offset_z,
+                cell_w=self.grid_width,
+                cell_h=self.grid_height,
+                cols=self.grid_cols,
+                rows=self.grid_rows,
+            ),
+            entity_capacity=global_settings.tpu_entity_capacity,
+            query_capacity=global_settings.tpu_query_capacity,
+        )
+
+    # ---- decision plane --------------------------------------------------
+
+    def notify(self, old_info, new_info, handover_data_provider) -> None:
+        """Record the movement; detection happens in the batched tick."""
+        entity_id = handover_data_provider(-1, -1)
+        if entity_id is None:
+            return
+        if entity_id not in self._last_positions:
+            # First sighting: the slot's device prev-cell must reflect the
+            # *old* position or the first crossing is undetectable.
+            slot = self.engine.add_entity(
+                entity_id, new_info.x, new_info.y, new_info.z
+            )
+            try:
+                old_cell = (
+                    self.get_channel_id(old_info)
+                    - global_settings.spatial_channel_id_start
+                )
+                self.engine.seed_cell(slot, old_cell)
+            except ValueError:
+                pass  # old position outside the world: no baseline
+        self.engine.update_entity(entity_id, new_info.x, new_info.y, new_info.z)
+        self._last_positions[entity_id] = new_info
+        self._providers[entity_id] = handover_data_provider
+
+    def track_entity(self, entity_id: int, info: SpatialInfo) -> None:
+        self.engine.add_entity(entity_id, info.x, info.y, info.z)
+        self._last_positions[entity_id] = info
+
+    def untrack_entity(self, entity_id: int) -> None:
+        self.engine.remove_entity(entity_id)
+        self._last_positions.pop(entity_id, None)
+        self._providers.pop(entity_id, None)
+
+    def tick(self) -> None:
+        super().tick()  # reap closed server connections
+        if self.engine is None or self.engine.entity_count() == 0:
+            return
+        from ..core import metrics
+
+        import time as _time
+
+        t0 = _time.monotonic()
+        result = self.engine.tick()
+        handovers = self.engine.handover_list(result)
+        metrics.tpu_step_latency.observe(_time.monotonic() - t0)
+        metrics.tpu_entities.set(self.engine.entity_count())
+        for entity_id, src_cell, dst_cell in handovers:
+            self._run_handover(entity_id, src_cell, dst_cell)
+
+    def _run_handover(self, entity_id: int, src_cell: int, dst_cell: int) -> None:
+        """Run the host orchestration for one device-detected crossing."""
+        provider = self._providers.get(entity_id)
+        if provider is None:
+            provider = lambda s, d: entity_id
+        old_info = self._cell_center(src_cell)
+        new_info = self._last_positions.get(entity_id) or self._cell_center(dst_cell)
+        # The parent orchestration recomputes src/dst from the infos; cell
+        # centers map back to exactly src_cell/dst_cell.
+        StaticGrid2DSpatialController.notify(self, old_info, new_info, provider)
+
+    def _cell_center(self, cell: int) -> SpatialInfo:
+        x = self.world_offset_x + (cell % self.grid_cols + 0.5) * self.grid_width
+        z = self.world_offset_z + (cell // self.grid_cols + 0.5) * self.grid_height
+        return SpatialInfo(x, 0, z)
+
+
+register_spatial_controller_type("TPUSpatialController", TPUSpatialController)

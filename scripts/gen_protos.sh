@@ -6,5 +6,6 @@ protoc -I. -I/usr/include --python_out=. \
     channeld_tpu/protocol/control.proto \
     channeld_tpu/protocol/spatial.proto \
     channeld_tpu/protocol/replay.proto \
-    channeld_tpu/models/testdata.proto
+    channeld_tpu/models/testdata.proto \
+    channeld_tpu/models/sim.proto
 echo "generated: channeld_tpu/protocol/*_pb2.py"

@@ -6,12 +6,18 @@ exercised without TPU hardware; env vars must be set before jax imports.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# Force CPU even though the image pins the axon TPU platform (this harness
+# ignores the JAX_PLATFORMS env var, so use the config API): tests exercise
+# sharding on 8 virtual devices; bench.py uses the real chip.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,276 @@
+"""Entity channels, groups, and cross-server handover orchestration.
+
+(ref: pkg/channeld/entity_test.go TestEntityChannelGroupController:11 and
+the handover call stack in spatial.go:612-858 / tpspb data.go:227-320.)
+"""
+
+import pytest
+
+from channeld_tpu.core.channel import (
+    create_channel_with_id,
+    create_entity_channel,
+    get_channel,
+)
+from channeld_tpu.core.message import MessageContext
+from channeld_tpu.core.types import (
+    ChannelType,
+    ConnectionType,
+    EntityGroupType,
+    MessageType,
+)
+from channeld_tpu.models import sim_pb2
+from channeld_tpu.models.sim import register_sim_types
+from channeld_tpu.protocol import control_pb2
+from channeld_tpu.spatial.controller import set_spatial_controller
+from channeld_tpu.spatial.grid import StaticGrid2DSpatialController
+from channeld_tpu.core.subscription import subscribe_to_channel
+
+from helpers import StubConnection, fresh_runtime
+
+START = 0x10000
+ENTITY_START = 0x80000
+
+
+@pytest.fixture(autouse=True)
+def runtime():
+    gch = fresh_runtime()
+    register_sim_types()
+    yield gch
+
+
+def make_world():
+    """2x1 world, one server per cell, with the sim data family."""
+    ctl = StaticGrid2DSpatialController()
+    ctl.load_config(
+        dict(WorldOffsetX=0, WorldOffsetZ=0, GridWidth=100, GridHeight=100,
+             GridCols=2, GridRows=1, ServerCols=2, ServerRows=1,
+             ServerInterestBorderSize=1)
+    )
+    set_spatial_controller(ctl)
+    server_a = StubConnection(1, ConnectionType.SERVER)
+    server_b = StubConnection(2, ConnectionType.SERVER)
+    for i, server in enumerate((server_a, server_b)):
+        ctx = MessageContext(
+            msg_type=MessageType.CREATE_CHANNEL,
+            msg=control_pb2.CreateChannelMessage(),
+            connection=server,
+        )
+        channels = ctl.create_channels(ctx)
+        # handle_create_spatial_channel subscribes the creator to its own
+        # authority cells (ref: message_spatial.go:166-171).
+        for ch in channels:
+            subscribe_to_channel(server, ch, None)
+    return ctl, server_a, server_b
+
+
+def entity_data(entity_id: int, x: float, z: float) -> sim_pb2.SimEntityChannelData:
+    d = sim_pb2.SimEntityChannelData()
+    d.state.entityId = entity_id
+    d.state.transform.position.x = x
+    d.state.transform.position.z = z
+    return d
+
+
+def test_entity_group_controller():
+    """The reference's five gameplay scenarios, verbatim
+    (ref: entity_test.go TestEntityChannelGroupController:11)."""
+    E = ENTITY_START
+    char_a, pc_a, ps_a = E + 1, E + 2, E + 3
+    ch_a = create_entity_channel(char_a, None)
+
+    # Case 1: character + controller + state hand over together.
+    ch_a.entity_controller.add_to_group(
+        EntityGroupType.HANDOVER, [char_a, pc_a, ps_a]
+    )
+    assert sorted(ch_a.entity_controller.get_handover_entities()) == [char_a, pc_a, ps_a]
+
+    # Case 2: cross-server attack locks A (via B's lock group cascade).
+    char_b, pc_b, ps_b = E + 4, E + 5, E + 6
+    ch_b = create_entity_channel(char_b, None)
+    ch_b.entity_controller.add_to_group(
+        EntityGroupType.HANDOVER, [char_b, pc_b, ps_b]
+    )
+    ch_b.entity_controller.add_to_group(EntityGroupType.LOCK, [char_a, char_b])
+    assert ch_a.entity_controller.get_handover_entities() == []
+
+    # Case 3: A leaves combat -> unlocked; B still locked.
+    ch_a.entity_controller.remove_from_group(EntityGroupType.LOCK, [char_a])
+    assert len(ch_a.entity_controller.get_handover_entities()) == 3
+    assert ch_b.entity_controller.get_handover_entities() == []
+
+    # Case 4: vehicle passengers hand over with the vehicle.
+    vehicle = E + 7
+    ch_v = create_entity_channel(vehicle, None)
+    char_c, pc_c, ps_c = E + 8, E + 9, E + 10
+    ch_c = create_entity_channel(char_c, None)
+    ch_c.entity_controller.add_to_group(
+        EntityGroupType.HANDOVER, [char_c, pc_c, ps_c]
+    )
+    ch_v.entity_controller.add_to_group(EntityGroupType.HANDOVER, [vehicle, char_c])
+    ch_c.entity_controller.add_to_group(EntityGroupType.LOCK, [char_c])
+    ch_v.entity_controller.add_to_group(EntityGroupType.HANDOVER, [vehicle, char_a])
+    ch_a.entity_controller.add_to_group(EntityGroupType.LOCK, [char_a])
+    assert ch_c.entity_controller.get_handover_entities() == []
+    vehicle_group = ch_v.entity_controller.get_handover_entities()
+    assert vehicle in vehicle_group and char_a in vehicle_group and char_c in vehicle_group
+
+    # A gets off the vehicle and regroups with its controller/state.
+    ch_v.entity_controller.remove_from_group(EntityGroupType.HANDOVER, [char_a])
+    ch_a.entity_controller.remove_from_group(EntityGroupType.LOCK, [char_a])
+    ch_a.entity_controller.add_to_group(
+        EntityGroupType.HANDOVER, [char_a, pc_a, ps_a]
+    )
+    assert len(ch_a.entity_controller.get_handover_entities()) == 3
+
+    # Case 5: A re-enters the vehicle, is attacked cross-server and pulled off.
+    ch_v.entity_controller.add_to_group(EntityGroupType.HANDOVER, [vehicle, char_a])
+    ch_b.entity_controller.add_to_group(EntityGroupType.LOCK, [char_a, char_b])
+    ch_v.entity_controller.remove_from_group(EntityGroupType.HANDOVER, [char_a])
+    assert ch_a.entity_controller.get_handover_entities() == []
+    vehicle_group = ch_v.entity_controller.get_handover_entities()
+    assert vehicle in vehicle_group
+    assert char_a not in vehicle_group
+    assert char_c in vehicle_group
+
+
+def test_handover_across_servers():
+    ctl, server_a, server_b = make_world()
+    src_ch = get_channel(START)
+    dst_ch = get_channel(START + 1)
+    assert src_ch.get_owner() is server_a
+    assert dst_ch.get_owner() is server_b
+
+    # Entity lives at x=50 (cell 0), owned by server A.
+    eid = ENTITY_START + 7
+    entity_ch = create_entity_channel(eid, server_a)
+    entity_ch.init_data(entity_data(eid, 50, 50), None)
+    entity_ch.spatial_notifier = ctl
+    subscribe_to_channel(server_a, entity_ch, None)
+
+    # Put the entity into the src spatial channel data.
+    src_ch.get_data_message().add_entity(eid, entity_ch.get_data_message())
+    assert eid in src_ch.get_data_message().entities
+
+    # A movement update crosses into cell 1 -> custom merge fires notify.
+    server_a.sent.clear()
+    server_b.sent.clear()
+    entity_ch.data.on_update(entity_data(eid, 150, 50), 0, server_a.id, ctl)
+
+    # Handover executes via channel.execute() queues; run the ticks.
+    src_ch.tick_once(0)
+    dst_ch.tick_once(0)
+
+    # Owner swapped to the destination server.
+    assert entity_ch.get_owner() is server_b
+    # Entity table moved between cells.
+    assert eid not in src_ch.get_data_message().entities
+    assert eid in dst_ch.get_data_message().entities
+
+    # Both servers saw the CHANNEL_DATA_HANDOVER message.
+    for server in (server_a, server_b):
+        handovers = [
+            ctx for ctx in server.sent
+            if ctx.msg_type == MessageType.CHANNEL_DATA_HANDOVER
+        ]
+        assert len(handovers) == 1
+        assert handovers[0].msg.srcChannelId == START
+        assert handovers[0].msg.dstChannelId == START + 1
+
+    # Destination server got auto-subscribed to the entity channel with
+    # write access (it is the new owner).
+    assert entity_ch.subscribed_connections.get(server_b) is not None
+
+
+def test_no_handover_within_same_cell():
+    ctl, server_a, server_b = make_world()
+    eid = ENTITY_START + 8
+    entity_ch = create_entity_channel(eid, server_a)
+    entity_ch.init_data(entity_data(eid, 10, 10), None)
+    server_a.sent.clear()
+    entity_ch.data.on_update(entity_data(eid, 20, 20), 0, server_a.id, ctl)
+    assert entity_ch.get_owner() is server_a
+    handovers = [
+        ctx for ctx in server_a.sent
+        if ctx.msg_type == MessageType.CHANNEL_DATA_HANDOVER
+    ]
+    assert handovers == []
+
+
+def test_locked_entity_does_not_hand_over():
+    ctl, server_a, server_b = make_world()
+    eid = ENTITY_START + 9
+    entity_ch = create_entity_channel(eid, server_a)
+    entity_ch.init_data(entity_data(eid, 50, 50), None)
+    entity_ch.entity_controller.add_to_group(EntityGroupType.HANDOVER, [eid])
+    entity_ch.entity_controller.add_to_group(EntityGroupType.LOCK, [eid])
+    src_ch = get_channel(START)
+    src_ch.get_data_message().add_entity(eid, entity_ch.get_data_message())
+
+    entity_ch.data.on_update(entity_data(eid, 150, 50), 0, server_a.id, ctl)
+    src_ch.tick_once(0)
+    get_channel(START + 1).tick_once(0)
+
+    # Locked: still owned by A, still in the src cell.
+    assert entity_ch.get_owner() is server_a
+    assert eid in src_ch.get_data_message().entities
+
+
+def test_tpu_controller_handover_parity():
+    """The device-backed controller detects the same crossing and runs the
+    same orchestration as the host path."""
+    from channeld_tpu.spatial.tpu_controller import TPUSpatialController
+    from channeld_tpu.core.settings import global_settings
+
+    global_settings.tpu_entity_capacity = 64
+    global_settings.tpu_query_capacity = 8
+
+    ctl = TPUSpatialController()
+    ctl.load_config(
+        dict(WorldOffsetX=0, WorldOffsetZ=0, GridWidth=100, GridHeight=100,
+             GridCols=2, GridRows=1, ServerCols=2, ServerRows=1,
+             ServerInterestBorderSize=1)
+    )
+    set_spatial_controller(ctl)
+    server_a = StubConnection(1, ConnectionType.SERVER)
+    server_b = StubConnection(2, ConnectionType.SERVER)
+    for server in (server_a, server_b):
+        ctx = MessageContext(
+            msg_type=MessageType.CREATE_CHANNEL,
+            msg=control_pb2.CreateChannelMessage(),
+            connection=server,
+        )
+        for ch in ctl.create_channels(ctx):
+            subscribe_to_channel(server, ch, None)
+
+    src_ch = get_channel(START)
+    dst_ch = get_channel(START + 1)
+    eid = ENTITY_START + 21
+    entity_ch = create_entity_channel(eid, server_a)
+    entity_ch.init_data(entity_data(eid, 50, 50), None)
+    entity_ch.spatial_notifier = ctl
+    subscribe_to_channel(server_a, entity_ch, None)
+    src_ch.get_data_message().add_entity(eid, entity_ch.get_data_message())
+
+    # Creation tracks the entity on device; a tick assigns its first cell.
+    from channeld_tpu.spatial.controller import SpatialInfo
+
+    ctl.track_entity(eid, SpatialInfo(50, 0, 50))
+    ctl.tick()
+
+    # Movement update: notify() only records the position on device.
+    entity_ch.data.on_update(entity_data(eid, 150, 50), 0, server_a.id, ctl)
+    assert entity_ch.get_owner() is server_a  # not yet: batch detection
+
+    # The batched device tick finds the crossing and orchestrates handover.
+    ctl.tick()
+    src_ch.tick_once(0)
+    dst_ch.tick_once(0)
+
+    assert entity_ch.get_owner() is server_b
+    assert eid not in src_ch.get_data_message().entities
+    assert eid in dst_ch.get_data_message().entities
+    handovers = [
+        ctx for ctx in server_b.sent
+        if ctx.msg_type == MessageType.CHANNEL_DATA_HANDOVER
+    ]
+    assert len(handovers) == 1

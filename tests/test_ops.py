@@ -1,0 +1,237 @@
+"""TPU decision-plane kernels vs the host semantics (CPU, 8 virtual devices)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from channeld_tpu.ops.engine import SpatialEngine
+from channeld_tpu.ops.spatial_ops import (
+    AOI_BOX,
+    AOI_CONE,
+    AOI_SPHERE,
+    GridSpec,
+    QuerySet,
+    aoi_masks,
+    assign_cells,
+    cell_counts,
+    fanout_due,
+)
+from channeld_tpu.spatial.controller import SpatialInfo
+from channeld_tpu.spatial.grid import StaticGrid2DSpatialController
+
+START = 0x10000
+
+GRID = GridSpec(offset_x=-150.0, offset_z=-150.0, cell_w=100.0, cell_h=100.0,
+                cols=3, rows=3)
+
+
+def host_controller() -> StaticGrid2DSpatialController:
+    ctl = StaticGrid2DSpatialController()
+    ctl.load_config(dict(
+        WorldOffsetX=GRID.offset_x, WorldOffsetZ=GRID.offset_z,
+        GridWidth=GRID.cell_w, GridHeight=GRID.cell_h,
+        GridCols=GRID.cols, GridRows=GRID.rows,
+        ServerCols=1, ServerRows=1, ServerInterestBorderSize=1,
+    ))
+    return ctl
+
+
+def test_assign_cells_matches_host_reference():
+    ctl = host_controller()
+    rng = np.random.default_rng(0)
+    pts = rng.uniform(-200, 200, size=(512, 3)).astype(np.float32)
+    valid = np.ones(512, bool)
+    cells = np.asarray(assign_cells(GRID, jnp.asarray(pts), jnp.asarray(valid)))
+    for p, c in zip(pts, cells):
+        try:
+            expected = ctl.get_channel_id(SpatialInfo(float(p[0]), 0, float(p[2]))) - START
+        except ValueError:
+            expected = -1
+        assert c == expected, p
+
+
+def test_aoi_sphere_superset_of_host_sampling():
+    """Device masks = exact overlap; must cover every host-sampled cell."""
+    from channeld_tpu.protocol import spatial_pb2
+
+    ctl = host_controller()
+    rng = np.random.default_rng(1)
+    for _ in range(20):
+        cx, cz = rng.uniform(-140, 140, 2)
+        r = rng.uniform(5, 200)
+        q = spatial_pb2.SpatialInterestQuery(
+            sphereAOI=spatial_pb2.SpatialInterestQuery.SphereAOI(
+                center=spatial_pb2.SpatialInfo(x=cx, z=cz), radius=r
+            )
+        )
+        host_cells = {k - START for k in ctl.query_channel_ids(q)}
+        queries = QuerySet(
+            kind=jnp.array([AOI_SPHERE]),
+            center=jnp.array([[cx, cz]], jnp.float32),
+            extent=jnp.array([[r, 0]], jnp.float32),
+            direction=jnp.array([[1.0, 0.0]], jnp.float32),
+            angle=jnp.array([0.0], jnp.float32),
+        )
+        hit, dist = aoi_masks(GRID, queries)
+        device_cells = set(np.nonzero(np.asarray(hit[0]))[0].tolist())
+        assert host_cells <= device_cells, (cx, cz, r, host_cells, device_cells)
+        # Distance metric agrees on the query's own cell.
+        own = ctl.get_channel_id(SpatialInfo(cx, 0, cz)) - START
+        assert int(dist[0, own]) == 0
+
+
+def test_aoi_cone_narrow_band():
+    # Narrow cone along +X from the center of the bottom-left cell: the
+    # bottom row only (mirrors the host geometry test expectations).
+    queries = QuerySet(
+        kind=jnp.array([AOI_CONE]),
+        center=jnp.array([[-100.0, -100.0]], jnp.float32),
+        extent=jnp.array([[1000.0, 0.0]], jnp.float32),
+        direction=jnp.array([[1.0, 0.0]], jnp.float32),
+        angle=jnp.array([0.1], jnp.float32),
+    )
+    hit, _ = aoi_masks(GRID, queries)
+    assert set(np.nonzero(np.asarray(hit[0]))[0].tolist()) == {0, 1, 2}
+
+
+def test_fanout_due_window_advance():
+    last = jnp.array([0, 0, 40], jnp.int32)
+    interval = jnp.array([50, 100, 50], jnp.int32)
+    active = jnp.array([True, True, False])
+    due, new_last = fanout_due(jnp.int32(60), last, interval, active)
+    assert due.tolist() == [True, False, False]
+    # Window advances by one interval, not to `now`.
+    assert new_last.tolist() == [50, 0, 40]
+
+
+def test_engine_tick_handover_and_interest():
+    eng = SpatialEngine(GRID, entity_capacity=64, query_capacity=8,
+                        sub_capacity=8, max_handovers=8)
+    eng.add_entity(1001, -100, 0, -100)  # cell 0
+    eng.add_entity(1002, 0, 0, 0)  # cell 4
+    eng.set_query(7, AOI_SPHERE, (0.0, 0.0), (40.0, 0.0))
+    s = eng.add_subscription(interval_ms=50, first_due_ms=0)
+
+    r1 = eng.tick(now_ms=0)
+    assert eng.handover_list(r1) == []  # first assignment: prev=-1, no crossing
+    counts = np.asarray(r1["cell_counts"])
+    assert counts[0] == 1 and counts[4] == 1
+    assert eng.interested_cells(r1, 7) == {4: 0}
+
+    # Entity 1001 moves two cells over; sub becomes due.
+    eng.update_entity(1001, 100, 0, -100)  # cell 2
+    r2 = eng.tick(now_ms=60)
+    assert eng.handover_list(r2) == [(1001, 0, 2)]
+    assert bool(np.asarray(r2["due"])[s])
+
+    # Removing the entity frees its slot and drops it from the counts.
+    eng.remove_entity(1001)
+    r3 = eng.tick(now_ms=70)
+    counts = np.asarray(r3["cell_counts"])
+    assert counts[2] == 0 and counts.sum() == 1
+
+
+def test_sharded_step_matches_single_device():
+    from channeld_tpu.parallel.mesh import (
+        build_sharded_step,
+        make_mesh,
+        sharded_spatial_step,
+    )
+
+    assert len(jax.devices()) == 8, "conftest must provide 8 virtual devices"
+    mesh = make_mesh()
+    n = 64  # 8 per shard
+    rng = np.random.default_rng(2)
+    pts = rng.uniform(-140, 140, size=(n, 3)).astype(np.float32)
+    valid = np.ones(n, bool)
+    prev = np.asarray(assign_cells(GRID, jnp.asarray(pts), jnp.asarray(valid)))
+    moved = pts.copy()
+    moved[:8, 0] += 120  # force some crossings
+    queries = QuerySet(
+        kind=jnp.array([AOI_SPHERE, 0], jnp.int32),
+        center=jnp.array([[0, 0], [0, 0]], jnp.float32),
+        extent=jnp.array([[80, 0], [0, 0]], jnp.float32),
+        direction=jnp.array([[1, 0], [1, 0]], jnp.float32),
+        angle=jnp.zeros(2, jnp.float32),
+    )
+    sub_state = (
+        jnp.zeros(4, jnp.int32),
+        jnp.full(4, 50, jnp.int32),
+        jnp.ones(4, bool),
+    )
+    step = build_sharded_step(GRID, mesh, max_handovers_per_shard=8)
+    out = sharded_spatial_step(
+        step, jnp.asarray(moved), jnp.asarray(prev), jnp.asarray(valid),
+        queries, sub_state, 60,
+    )
+
+    # Reference: single-device computation.
+    new_cells = np.asarray(assign_cells(GRID, jnp.asarray(moved), jnp.asarray(valid)))
+    assert np.array_equal(np.asarray(out["cell_of"]), new_cells)
+    expected_counts = np.asarray(cell_counts(jnp.asarray(new_cells), GRID.num_cells))
+    assert np.array_equal(np.asarray(out["cell_counts"]), expected_counts)
+
+    # Handover rows across shards cover exactly the crossed entities.
+    crossed = {i for i in range(n) if prev[i] >= 0 and new_cells[i] >= 0
+               and prev[i] != new_cells[i]}
+    rows = np.asarray(out["handovers"]).reshape(-1, 3)
+    got = {int(r[0]) for r in rows if r[0] >= 0}
+    assert got == crossed
+    assert int(np.asarray(out["handover_counts"]).sum()) == len(crossed)
+
+
+def test_slot_reuse_does_not_fabricate_handover():
+    """Code-review regression: freed slot's prev cell must not leak."""
+    eng = SpatialEngine(GRID, entity_capacity=8, query_capacity=2,
+                        sub_capacity=2, max_handovers=8)
+    eng.add_entity(1, -100, 0, -100)  # cell 0
+    eng.tick(now_ms=0)
+    eng.remove_entity(1)
+    eng.add_entity(2, 100, 0, 100)  # cell 8, reuses slot of entity 1
+    r = eng.tick(now_ms=33)
+    assert eng.handover_list(r) == []
+
+
+def test_first_sighting_seed_enables_first_crossing():
+    """Code-review regression: a never-tracked entity's first cross-cell
+    move must hand over (prev cell seeded from the old position)."""
+    from channeld_tpu.core.settings import global_settings
+    from channeld_tpu.spatial.controller import SpatialInfo
+    from channeld_tpu.spatial.tpu_controller import TPUSpatialController
+
+    global_settings.tpu_entity_capacity = 16
+    global_settings.tpu_query_capacity = 4
+    ctl = TPUSpatialController()
+    ctl.load_config(dict(
+        WorldOffsetX=GRID.offset_x, WorldOffsetZ=GRID.offset_z,
+        GridWidth=GRID.cell_w, GridHeight=GRID.cell_h,
+        GridCols=GRID.cols, GridRows=GRID.rows,
+        ServerCols=1, ServerRows=1, ServerInterestBorderSize=1,
+    ))
+    eid = 0x80001
+    ctl.notify(SpatialInfo(-100, 0, -100), SpatialInfo(100, 0, 100),
+               lambda s, d: eid)
+    r = ctl.engine.tick(now_ms=0)
+    assert ctl.engine.handover_list(r) == [(eid, 0, 8)]
+
+
+def test_handover_overflow_redetected_next_tick():
+    """Code-review regression: crossings beyond max_handovers survive as
+    next-tick detections instead of being dropped."""
+    eng = SpatialEngine(GRID, entity_capacity=8, query_capacity=2,
+                        sub_capacity=2, max_handovers=2)
+    for i in range(4):
+        eng.add_entity(100 + i, -100, 0, -100)  # all in cell 0
+    eng.tick(now_ms=0)
+    for i in range(4):
+        eng.update_entity(100 + i, 100, 0, 100)  # all cross to cell 8
+    r1 = eng.tick(now_ms=33)
+    assert int(r1["handover_count"]) == 4
+    first = eng.handover_list(r1)
+    assert len(first) == 2  # row budget
+    r2 = eng.tick(now_ms=66)
+    second = eng.handover_list(r2)
+    assert len(second) == 2
+    assert {e for e, _, _ in first} | {e for e, _, _ in second} == {100, 101, 102, 103}

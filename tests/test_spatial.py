@@ -1,0 +1,342 @@
+"""Spatial layer: grid math, AOI geometry, server allocation + borders.
+
+Replicates reference expectations (ref: pkg/channeld/spatial_test.go:
+TestGetChannelId1:803, TestGetChannelId2:762, TestGetAdjacentChannels:493,
+TestConeAOI:21, TestSphereAOI:244, TestBoxAOI:362,
+TestCreateSpatialChannels1:613).
+"""
+
+import math
+
+import pytest
+
+from channeld_tpu.core.message import MessageContext
+from channeld_tpu.core.types import ChannelType, ConnectionType, MessageType
+from channeld_tpu.protocol import control_pb2, spatial_pb2
+from channeld_tpu.spatial.controller import SpatialInfo
+from channeld_tpu.spatial.grid import StaticGrid2DSpatialController
+
+from helpers import StubConnection, fresh_runtime
+
+START = 0x10000  # spatial channel id start
+
+
+@pytest.fixture(autouse=True)
+def runtime():
+    yield fresh_runtime()
+
+
+def make_ctl(**kw) -> StaticGrid2DSpatialController:
+    ctl = StaticGrid2DSpatialController()
+    cfg = dict(
+        WorldOffsetX=0, WorldOffsetZ=0, GridWidth=10, GridHeight=10,
+        GridCols=1, GridRows=1, ServerCols=1, ServerRows=1,
+        ServerInterestBorderSize=0,
+    )
+    cfg.update(kw)
+    ctl.load_config(cfg)
+    return ctl
+
+
+def cone_query(cx, cz, dx, dz, radius, angle):
+    return spatial_pb2.SpatialInterestQuery(
+        coneAOI=spatial_pb2.SpatialInterestQuery.ConeAOI(
+            center=spatial_pb2.SpatialInfo(x=cx, z=cz),
+            direction=spatial_pb2.SpatialInfo(x=dx, z=dz),
+            radius=radius,
+            angle=angle,
+        )
+    )
+
+
+def test_get_channel_id_no_offset():
+    """(ref: TestGetChannelId2:762)."""
+    ctl = make_ctl(GridWidth=100, GridHeight=50, GridCols=9, GridRows=8,
+                   ServerCols=3, ServerRows=4, ServerInterestBorderSize=2)
+    assert ctl.get_channel_id(SpatialInfo(0, 0, 0)) == START
+    assert ctl.get_channel_id(SpatialInfo(100, 0, 0)) == START + 1
+    assert ctl.get_channel_id(SpatialInfo(0, 0, 50)) == START + 9
+    assert ctl.get_channel_id(SpatialInfo(899.99, 0, 399.99)) == START + 9 * 8 - 1
+    for x, z in [(-1, 0), (1e308, 0), (0, -1), (900, 400)]:
+        with pytest.raises(ValueError):
+            ctl.get_channel_id(SpatialInfo(x, 0, z))
+
+
+def test_get_channel_id_with_offset():
+    """(ref: TestGetChannelId1:803)."""
+    ctl = make_ctl(WorldOffsetX=-450, WorldOffsetZ=-200, GridWidth=100,
+                   GridHeight=50, GridCols=9, GridRows=8, ServerCols=3,
+                   ServerRows=4, ServerInterestBorderSize=2)
+    assert ctl.get_channel_id(SpatialInfo(-450, 0, -200)) == START
+    assert ctl.get_channel_id(SpatialInfo(-350, 0, -200)) == START + 1
+    assert ctl.get_channel_id(SpatialInfo(-450, 0, -150)) == START + 9
+    assert ctl.get_channel_id(SpatialInfo(0, 0, 0)) == START + 9 * 4 + 4
+    assert ctl.get_channel_id(SpatialInfo(449.99, 0, 199.99)) == START + 9 * 8 - 1
+    for x, z in [(-500, 0), (500, 0), (0, -300), (0, 300), (450, 200)]:
+        with pytest.raises(ValueError):
+            ctl.get_channel_id(SpatialInfo(x, 0, z))
+
+
+def test_get_adjacent_channels():
+    """(ref: TestGetAdjacentChannels:493)."""
+    ctl1 = make_ctl()
+    assert ctl1.get_adjacent_channels(START) == []
+
+    ctl2 = make_ctl(WorldOffsetX=-5, WorldOffsetZ=-5, GridWidth=5, GridHeight=5,
+                    GridCols=2, GridRows=2)
+    assert len(ctl2.get_adjacent_channels(START)) == 3
+
+    ctl3 = make_ctl(GridCols=3, GridRows=3)
+    center = START + 4
+    adj = ctl3.get_adjacent_channels(center)
+    assert len(adj) == 8 and center not in adj
+
+
+def test_cone_aoi():
+    """(ref: TestConeAOI:21)."""
+    ctl1 = make_ctl()
+    result = ctl1.query_channel_ids(cone_query(5, 5, 1, 0, 1, math.pi / 4))
+    assert START in result
+
+    ctl2 = make_ctl(GridCols=4)
+    result = ctl2.query_channel_ids(cone_query(0, 5, 1, 0, 1, math.pi / 4))
+    assert START in result
+    assert len(ctl2.query_channel_ids(cone_query(0, 5, 1, 0, 25, math.pi / 4))) == 3
+    assert len(ctl2.query_channel_ids(cone_query(0, 5, 1, 0, 100, math.pi / 4))) == 4
+    assert len(ctl2.query_channel_ids(cone_query(0, 5, 0, 1, 100, math.pi / 4))) == 1
+
+    ctl3 = make_ctl(GridCols=3, GridRows=3)
+    # Narrow cone along +X from the bottom-left cell: bottom row only.
+    assert len(ctl3.query_channel_ids(cone_query(5, 5, 1, 0, 100, 0.1))) == 3
+    # Wider cone sweeps the diagonal band.
+    assert len(ctl3.query_channel_ids(cone_query(5, 5, 1, 0, 100, math.pi / 4))) == 6
+    # From center cell pointing -X.
+    assert len(ctl3.query_channel_ids(cone_query(15, 15, -1, 0, 100, math.pi / 4))) == 4
+    # From middle-left cell pointing -Z.
+    assert len(ctl3.query_channel_ids(cone_query(5, 15, 0, -1, 100, math.pi / 4))) == 3
+
+    ctl4 = make_ctl(WorldOffsetX=-2000, WorldOffsetZ=-500, GridWidth=1000,
+                    GridHeight=1000, GridCols=4, GridRows=1, ServerCols=2,
+                    ServerInterestBorderSize=1)
+    result = ctl4.query_channel_ids(
+        cone_query(1250, 0, -0.087, 0.996, 30000, 0.5236)
+    )
+    assert len(result) == 1
+
+
+def test_sphere_aoi():
+    """(ref: TestSphereAOI:244)."""
+    ctl1 = make_ctl()
+    q = spatial_pb2.SpatialInterestQuery(
+        sphereAOI=spatial_pb2.SpatialInterestQuery.SphereAOI(
+            center=spatial_pb2.SpatialInfo(x=5, z=5), radius=1
+        )
+    )
+    assert START in ctl1.query_channel_ids(q)
+    q.sphereAOI.radius = 100
+    assert START in ctl1.query_channel_ids(q)
+
+    ctl2 = make_ctl(WorldOffsetX=-5, WorldOffsetZ=-5, GridWidth=5, GridHeight=5,
+                    GridCols=2, GridRows=2)
+    q2 = spatial_pb2.SpatialInterestQuery(
+        sphereAOI=spatial_pb2.SpatialInterestQuery.SphereAOI(
+            center=spatial_pb2.SpatialInfo(x=0, z=0), radius=1
+        )
+    )
+    # Center sits on the 4-corner: all 4 cells are within radius 1.
+    assert len(ctl2.query_channel_ids(q2)) == 4
+    # Distances: center cell 0, others near.
+    assert ctl2.query_channel_ids(q2)[START + 3] == 0
+
+
+def test_box_aoi():
+    """(ref: TestBoxAOI:362)."""
+
+    def box_query(cx, cz, ex, ez):
+        return spatial_pb2.SpatialInterestQuery(
+            boxAOI=spatial_pb2.SpatialInterestQuery.BoxAOI(
+                center=spatial_pb2.SpatialInfo(x=cx, z=cz),
+                extent=spatial_pb2.SpatialInfo(x=ex, z=ez),
+            )
+        )
+
+    ctl1 = make_ctl()
+    assert START in ctl1.query_channel_ids(box_query(5, 5, 1, 1))
+    assert START in ctl1.query_channel_ids(box_query(5, 5, 100, 100))
+
+    ctl2 = make_ctl(WorldOffsetX=-5, WorldOffsetZ=-5, GridWidth=5, GridHeight=5,
+                    GridCols=2, GridRows=2)
+    # Box straddling the 4-corner touches all 4 cells.
+    assert len(ctl2.query_channel_ids(box_query(0, 0, 1, 1))) == 4
+    # Box fully inside the top-right cell.
+    result = ctl2.query_channel_ids(box_query(4.9, 4.9, 1, 1))
+    assert set(result.keys()) == {START + 3}
+    assert len(ctl2.query_channel_ids(box_query(4.9, 4.9, 4.9, 4.9))) == 1
+    # Taller box reaches down into the bottom-right cell too.
+    assert len(ctl2.query_channel_ids(box_query(4.9, 4.9, 4.9, 10))) == 2
+
+    ctl3 = make_ctl(WorldOffsetX=-150, WorldOffsetZ=-150, GridWidth=100,
+                    GridHeight=100, GridCols=3, GridRows=3)
+    assert len(ctl3.query_channel_ids(box_query(0, 0, 150, 150))) == 9
+    assert len(ctl3.query_channel_ids(box_query(0, 0, 100, 100))) == 9
+
+
+def test_spots_aoi():
+    ctl = make_ctl(GridCols=3, GridRows=3)
+    q = spatial_pb2.SpatialInterestQuery(
+        spotsAOI=spatial_pb2.SpatialInterestQuery.SpotsAOI(
+            spots=[
+                spatial_pb2.SpatialInfo(x=5, z=5),
+                spatial_pb2.SpatialInfo(x=25, z=25),
+                spatial_pb2.SpatialInfo(x=-100, z=0),  # out of world: ignored
+            ],
+            dists=[0, 2],
+        )
+    )
+    result = ctl.query_channel_ids(q)
+    assert result == {START: 0, START + 8: 2}
+
+
+def test_regions_server_index():
+    ctl = make_ctl(GridWidth=100, GridHeight=50, GridCols=9, GridRows=8,
+                   ServerCols=3, ServerRows=4, ServerInterestBorderSize=2)
+    regions = ctl.get_regions()
+    assert len(regions) == 72
+    assert regions[0].serverIndex == 0
+    assert regions[0].channelId == START
+    # Grid (8,7) belongs to the last server (index 11).
+    last = regions[-1]
+    assert last.channelId == START + 71
+    assert last.serverIndex == 11
+    # Region bounds.
+    assert regions[0].min.x == 0 and regions[0].max.x == 100
+    assert regions[0].min.z == 0 and regions[0].max.z == 50
+
+
+def test_create_spatial_channels_with_borders():
+    """6 fake servers allocate a 4x3 world of 2x1 blocks; border subs match
+    the reference's exact sets (ref: TestCreateSpatialChannels1:613)."""
+    ctl = make_ctl(WorldOffsetX=-40, WorldOffsetZ=-60, GridWidth=20,
+                   GridHeight=40, GridCols=4, GridRows=3, ServerCols=2,
+                   ServerRows=3, ServerInterestBorderSize=1)
+
+    conns = [StubConnection(10 + i, ConnectionType.SERVER) for i in range(6)]
+
+    def create_for(conn):
+        ctx = MessageContext(
+            msg_type=MessageType.CREATE_CHANNEL,
+            msg=control_pb2.CreateChannelMessage(),
+            connection=conn,
+        )
+        return ctl.create_channels(ctx)
+
+    server0_channels = create_for(conns[0])
+    assert [ch.id for ch in server0_channels] == [START, START + 1]
+    for i in range(1, 6):
+        assert len(create_for(conns[i])) == 2
+    assert ctl._next_server_index() == 6
+
+    # Authority map (world rows bottom-up; ids left-right):
+    #   8  9 | 10 11     servers: 4 | 5
+    #   4  5 |  6  7              2 | 3
+    #   0  1 |  2  3              0 | 1
+    def subscribed(conn):
+        from channeld_tpu.core.channel import all_channels
+
+        return {
+            ch.id for ch in all_channels().values()
+            if conn in ch.subscribed_connections
+        }
+
+    assert {START + 2, START + 4, START + 5} <= subscribed(conns[0])
+    assert {START + 1, START + 6, START + 7} <= subscribed(conns[1])
+    assert {START + 0, START + 1, START + 6, START + 8, START + 9} <= subscribed(conns[2])
+    assert {START + 2, START + 3, START + 5, START + 10, START + 11} <= subscribed(conns[3])
+    assert {START + 6, START + 7, START + 9} <= subscribed(conns[5])
+
+    # Every server received SPATIAL_CHANNELS_READY once all joined.
+    for conn in conns:
+        ready = [
+            ctx for ctx in conn.sent
+            if ctx.msg_type == MessageType.SPATIAL_CHANNELS_READY
+        ]
+        assert len(ready) == 1
+        assert ready[0].msg.serverCount == 6
+
+
+def test_all_servers_allocated_raises():
+    """(ref: TestCreateSpatialChannels3:555)."""
+    ctl = make_ctl(GridWidth=33, GridHeight=77, GridCols=2, GridRows=2,
+                   ServerCols=2, ServerRows=2)
+    conn = StubConnection(99, ConnectionType.SERVER)
+    ctx = MessageContext(
+        msg_type=MessageType.CREATE_CHANNEL,
+        msg=control_pb2.CreateChannelMessage(),
+        connection=conn,
+    )
+    for _ in range(4):
+        assert len(ctl.create_channels(ctx)) == 1
+    with pytest.raises(RuntimeError):
+        ctl.create_channels(ctx)
+
+
+def test_update_spatial_interest_flow():
+    """Client AOI query -> damped subs -> diff-based unsub
+    (ref: message_spatial.go:41-129 and the §3.5 call stack)."""
+    from channeld_tpu.core import connection as connection_mod
+    from channeld_tpu.core.channel import all_channels, get_channel
+    from channeld_tpu.core.subscription import subscribe_to_channel
+    from channeld_tpu.models.sim import register_sim_types
+    from channeld_tpu.spatial.controller import set_spatial_controller
+    from channeld_tpu.spatial.messages import handle_update_spatial_interest
+
+    register_sim_types()
+    ctl = make_ctl(GridCols=3, GridRows=3, ServerCols=1, ServerRows=1)
+    set_spatial_controller(ctl)
+
+    server = StubConnection(1, ConnectionType.SERVER)
+    ctx = MessageContext(
+        msg_type=MessageType.CREATE_CHANNEL,
+        msg=control_pb2.CreateChannelMessage(),
+        connection=server,
+    )
+    channels = ctl.create_channels(ctx)
+    assert len(channels) == 9
+
+    # A real registry-backed client connection (handler looks it up by id).
+    from helpers import FakeTransport
+
+    client = connection_mod.add_connection(FakeTransport(), ConnectionType.CLIENT)
+    client.state = 1  # authenticated
+
+    def update_interest(cx, cz, radius):
+        q = spatial_pb2.SpatialInterestQuery(
+            sphereAOI=spatial_pb2.SpatialInterestQuery.SphereAOI(
+                center=spatial_pb2.SpatialInfo(x=cx, z=cz), radius=radius
+            )
+        )
+        ictx = MessageContext(
+            msg_type=MessageType.UPDATE_SPATIAL_INTEREST,
+            msg=spatial_pb2.UpdateSpatialInterestMessage(connId=client.id, query=q),
+            connection=server,
+            channel=get_channel(START + 4),
+            channel_id=START + 4,
+        )
+        handle_update_spatial_interest(ictx)
+        # Cross-channel sub/unsubs run in each channel's own queue.
+        for ch in list(all_channels().values()):
+            ch.tick_once(0)
+
+    # Interest around the center cell covers all 9 cells.
+    update_interest(15, 15, 15)
+    assert len(client.spatial_subscriptions) == 9
+    # Damping: the center cell updates fast, far cells slower.
+    assert client.spatial_subscriptions[START + 4].fanOutIntervalMs == 20
+    corner_interval = client.spatial_subscriptions[START].fanOutIntervalMs
+    assert corner_interval in (50, 100)
+
+    # Move interest to the bottom-left corner: far cells get unsubscribed.
+    update_interest(2, 2, 6)
+    assert START in client.spatial_subscriptions
+    assert START + 8 not in client.spatial_subscriptions
+    assert len(client.spatial_subscriptions) < 9
