@@ -1,1 +1,3 @@
+from .client import Client, MessageHandler
 
+__all__ = ["Client", "MessageHandler"]

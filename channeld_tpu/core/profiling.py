@@ -1,0 +1,85 @@
+"""Profiling hooks (ref: pkg/channeld/profiling.go:12-31).
+
+``-profile cpu`` -> cProfile, ``-profile mem`` -> tracemalloc; results are
+written to the profile path on shutdown, with a signal-safe stop on
+SIGINT/SIGTERM like the reference's pkg/profile integration.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import signal
+import time
+from typing import Optional
+
+from ..utils.logger import get_logger
+
+logger = get_logger("profiling")
+
+_cpu_profiler = None
+_mem_tracing = False
+_profile_path = "profiles"
+
+
+def start_profiling(kind: str, profile_path: str = "profiles") -> None:
+    """(ref: StartProfiling). kind in {"", "cpu", "mem"}."""
+    global _cpu_profiler, _mem_tracing, _profile_path
+    if not kind:
+        return
+    _profile_path = profile_path
+    os.makedirs(profile_path, exist_ok=True)
+    if kind == "cpu":
+        import cProfile
+
+        _cpu_profiler = cProfile.Profile()
+        _cpu_profiler.enable()
+        logger.info("CPU profiling started")
+    elif kind == "mem":
+        import tracemalloc
+
+        tracemalloc.start()
+        _mem_tracing = True
+        logger.info("memory profiling started")
+    else:
+        raise ValueError(f"invalid profile type: {kind}")
+
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            signal.signal(sig, _stop_and_exit)
+        except ValueError:
+            pass  # not the main thread
+    atexit.register(stop_profiling)
+
+
+def stop_profiling() -> Optional[str]:
+    global _cpu_profiler, _mem_tracing
+    stamp = time.strftime("%Y%m%d%H%M%S")
+    if _cpu_profiler is not None:
+        path = os.path.join(_profile_path, f"cpu_{stamp}.pstats")
+        _cpu_profiler.disable()
+        _cpu_profiler.dump_stats(path)
+        _cpu_profiler = None
+        logger.info("CPU profile written to %s", path)
+        return path
+    if _mem_tracing:
+        import tracemalloc
+
+        path = os.path.join(_profile_path, f"mem_{stamp}.txt")
+        snapshot = tracemalloc.take_snapshot()
+        with open(path, "w") as f:
+            for stat in snapshot.statistics("lineno")[:100]:
+                f.write(f"{stat}\n")
+        tracemalloc.stop()
+        _mem_tracing = False
+        logger.info("memory profile written to %s", path)
+        return path
+    return None
+
+
+def _stop_and_exit(signum, frame) -> None:
+    # Flush the profile, then re-deliver the signal with default semantics
+    # so exit codes (130/143) and KeyboardInterrupt behavior are preserved.
+    stop_profiling()
+    signal.signal(signum, signal.SIG_DFL)
+    os.kill(os.getpid(), signum)

@@ -152,6 +152,10 @@ async def run_server(argv: Optional[list[str]] = None) -> None:
     """Full bootstrap (ref: cmd/main.go:12-56)."""
     global_settings.parse_flags(argv)
     init_logs(development=global_settings.development)
+    if global_settings.profile:
+        from .profiling import start_profiling
+
+        start_profiling(global_settings.profile, global_settings.profile_path)
     init_connections(global_settings.server_fsm, global_settings.client_fsm)
     init_channels()
     init_anti_ddos()

@@ -1,0 +1,54 @@
+"""Chat channel-data behavior: custom list merge with time-span truncation
+(ref: examples/chat-rooms/chatpb/merge.go:14-49).
+
+When the merged message list exceeds listSizeLimit with truncateTop, the
+head is trimmed — but messages younger than TIME_SPAN_LIMIT survive even
+beyond the limit, so a burst of fresh chat is never cut mid-conversation.
+"""
+
+from __future__ import annotations
+
+import time
+
+from . import chat_pb2
+
+ChatMessage = chat_pb2.ChatMessage
+ChatChannelData = chat_pb2.ChatChannelData
+
+# Messages newer than this always survive a top-truncation (seconds).
+TIME_SPAN_LIMIT = 10.0
+
+
+def _chat_merge(self, src, options, spatial_notifier) -> None:
+    if not isinstance(src, ChatChannelData):
+        raise TypeError("src is not a ChatChannelData")
+    if options is not None and options.shouldReplaceList:
+        del self.chatMessages[:]
+    self.chatMessages.extend(src.chatMessages)
+
+    if options is None:
+        return
+    limit = options.listSizeLimit
+    n = len(self.chatMessages)
+    if limit > 0 and n > limit:
+        if options.truncateTop:
+            start = n - limit
+            if TIME_SPAN_LIMIT > 0:
+                available_ms = (time.time() - TIME_SPAN_LIMIT) * 1000
+                while start > 0 and self.chatMessages[start - 1].sendTime >= available_ms:
+                    start -= 1
+            del self.chatMessages[:start]
+        else:
+            del self.chatMessages[limit:]
+
+
+ChatChannelData.merge = _chat_merge
+
+
+def register_chat_types() -> None:
+    from ..core.data import register_channel_data_type
+    from ..core.types import ChannelType
+
+    register_channel_data_type(ChannelType.GLOBAL, ChatChannelData())
+    register_channel_data_type(ChannelType.SUBWORLD, ChatChannelData())
+    register_channel_data_type(ChannelType.PRIVATE, ChatChannelData())

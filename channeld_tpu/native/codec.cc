@@ -1,0 +1,246 @@
+// Native wire codec for channeld-tpu.
+//
+// The per-packet hot path — 5-byte tag framing plus snappy compression
+// (wire spec: ref pkg/channeld/connection.go:445-541, :683-697) — as a
+// CPython extension. The gateway handles every inbound/outbound byte
+// through this codec; the Python implementation in protocol/framing.py
+// stays as the semantic reference and fallback.
+//
+// Linked against the system libsnappy via its stable C ABI (snappy-c.h);
+// prototypes are declared here because the image ships the library
+// without headers.
+//
+// Build: scripts/build_native.sh  ->  channeld_tpu/native/_codec.*.so
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+// snappy-c.h stable ABI (status: 0 = OK, 1 = INVALID_INPUT, 2 = BUFFER_TOO_SMALL)
+int snappy_compress(const char* input, size_t input_length, char* compressed,
+                    size_t* compressed_length);
+int snappy_uncompress(const char* compressed, size_t compressed_length,
+                      char* uncompressed, size_t* uncompressed_length);
+size_t snappy_max_compressed_length(size_t source_length);
+int snappy_uncompressed_length(const char* compressed, size_t compressed_length,
+                               size_t* result);
+}
+
+static const unsigned char MAGIC0 = 0x43;  // 'C'
+static const unsigned char MAGIC1 = 0x48;  // 'H'
+static const size_t HEADER_SIZE = 5;
+static const size_t MAX_PACKET_SIZE = 0xFFFF;
+
+static PyObject* CodecError;
+
+// encode_frame(body: bytes, compression: int = 0) -> bytes
+static PyObject* codec_encode_frame(PyObject* self, PyObject* args) {
+  Py_buffer body;
+  int compression = 0;
+  if (!PyArg_ParseTuple(args, "y*|i", &body, &compression)) return nullptr;
+
+  const char* payload = static_cast<const char*>(body.buf);
+  size_t payload_len = static_cast<size_t>(body.len);
+  char* scratch = nullptr;
+
+  if (compression == 1) {
+    size_t max_len = snappy_max_compressed_length(payload_len);
+    scratch = static_cast<char*>(PyMem_Malloc(max_len));
+    if (!scratch) {
+      PyBuffer_Release(&body);
+      return PyErr_NoMemory();
+    }
+    size_t compressed_len = max_len;
+    if (snappy_compress(payload, payload_len, scratch, &compressed_len) == 0 &&
+        compressed_len < payload_len) {
+      payload = scratch;
+      payload_len = compressed_len;
+    } else {
+      // Incompressible (or error): store raw, mirroring the Python codec.
+      compression = 0;
+    }
+  }
+
+  if (payload_len > MAX_PACKET_SIZE) {
+    if (scratch) PyMem_Free(scratch);
+    PyBuffer_Release(&body);
+    PyErr_Format(CodecError, "packet oversized: %zu", payload_len);
+    return nullptr;
+  }
+
+  PyObject* out = PyBytes_FromStringAndSize(nullptr,
+                                            (Py_ssize_t)(HEADER_SIZE + payload_len));
+  if (out) {
+    unsigned char* dst =
+        reinterpret_cast<unsigned char*>(PyBytes_AS_STRING(out));
+    dst[0] = MAGIC0;
+    dst[1] = MAGIC1;
+    dst[2] = (unsigned char)((payload_len >> 8) & 0xFF);
+    dst[3] = (unsigned char)(payload_len & 0xFF);
+    dst[4] = (unsigned char)compression;
+    memcpy(dst + HEADER_SIZE, payload, payload_len);
+  }
+  if (scratch) PyMem_Free(scratch);
+  PyBuffer_Release(&body);
+  return out;
+}
+
+// decode_frames(buf: bytes-like) -> (list[tuple[bytes, int]], consumed: int)
+//
+// Parses every complete frame in buf, decompressing snappy bodies.
+// Raises CodecError on a bad magic or zero-size frame (connection-fatal).
+static PyObject* codec_decode_frames(PyObject* self, PyObject* args) {
+  Py_buffer buf;
+  if (!PyArg_ParseTuple(args, "y*", &buf)) return nullptr;
+
+  const unsigned char* data = static_cast<const unsigned char*>(buf.buf);
+  size_t len = static_cast<size_t>(buf.len);
+  size_t pos = 0;
+
+  PyObject* frames = PyList_New(0);
+  if (!frames) {
+    PyBuffer_Release(&buf);
+    return nullptr;
+  }
+
+  while (len - pos >= HEADER_SIZE) {
+    const unsigned char* tag = data + pos;
+    if (tag[0] != MAGIC0 || tag[1] != MAGIC1) {
+      Py_DECREF(frames);
+      PyBuffer_Release(&buf);
+      PyErr_Format(CodecError, "invalid tag at offset %zu", pos);
+      return nullptr;
+    }
+    size_t size = ((size_t)tag[2] << 8) | (size_t)tag[3];
+    if (size == 0) {
+      Py_DECREF(frames);
+      PyBuffer_Release(&buf);
+      PyErr_SetString(CodecError, "zero-size frame");
+      return nullptr;
+    }
+    if (len - pos < HEADER_SIZE + size) break;  // incomplete frame
+    int ct = tag[4];
+    const char* body = reinterpret_cast<const char*>(tag + HEADER_SIZE);
+
+    PyObject* payload = nullptr;
+    if (ct == 1) {
+      size_t out_len = 0;
+      if (snappy_uncompressed_length(body, size, &out_len) != 0) {
+        Py_DECREF(frames);
+        PyBuffer_Release(&buf);
+        PyErr_SetString(CodecError, "corrupt snappy length preamble");
+        return nullptr;
+      }
+      payload = PyBytes_FromStringAndSize(nullptr, (Py_ssize_t)out_len);
+      if (payload &&
+          snappy_uncompress(body, size, PyBytes_AS_STRING(payload), &out_len) != 0) {
+        Py_DECREF(payload);
+        Py_DECREF(frames);
+        PyBuffer_Release(&buf);
+        PyErr_SetString(CodecError, "corrupt snappy data");
+        return nullptr;
+      }
+    } else {
+      payload = PyBytes_FromStringAndSize(body, (Py_ssize_t)size);
+    }
+    if (!payload) {
+      Py_DECREF(frames);
+      PyBuffer_Release(&buf);
+      return nullptr;
+    }
+    PyObject* item = Py_BuildValue("(Ni)", payload, ct);
+    if (!item || PyList_Append(frames, item) < 0) {
+      Py_XDECREF(item);
+      Py_DECREF(frames);
+      PyBuffer_Release(&buf);
+      return nullptr;
+    }
+    Py_DECREF(item);
+    pos += HEADER_SIZE + size;
+  }
+
+  PyBuffer_Release(&buf);
+  return Py_BuildValue("(Nn)", frames, (Py_ssize_t)pos);
+}
+
+// compress(data: bytes) -> bytes ; uncompress(data: bytes) -> bytes
+static PyObject* codec_compress(PyObject* self, PyObject* args) {
+  Py_buffer in;
+  if (!PyArg_ParseTuple(args, "y*", &in)) return nullptr;
+  size_t max_len = snappy_max_compressed_length((size_t)in.len);
+  PyObject* out = PyBytes_FromStringAndSize(nullptr, (Py_ssize_t)max_len);
+  if (!out) {
+    PyBuffer_Release(&in);
+    return nullptr;
+  }
+  size_t out_len = max_len;
+  int status = snappy_compress(static_cast<const char*>(in.buf), (size_t)in.len,
+                               PyBytes_AS_STRING(out), &out_len);
+  PyBuffer_Release(&in);
+  if (status != 0) {
+    Py_DECREF(out);
+    PyErr_Format(CodecError, "snappy_compress failed: %d", status);
+    return nullptr;
+  }
+  if (_PyBytes_Resize(&out, (Py_ssize_t)out_len) < 0) return nullptr;
+  return out;
+}
+
+static PyObject* codec_uncompress(PyObject* self, PyObject* args) {
+  Py_buffer in;
+  if (!PyArg_ParseTuple(args, "y*", &in)) return nullptr;
+  size_t out_len = 0;
+  if (snappy_uncompressed_length(static_cast<const char*>(in.buf), (size_t)in.len,
+                                 &out_len) != 0) {
+    PyBuffer_Release(&in);
+    PyErr_SetString(CodecError, "corrupt snappy length preamble");
+    return nullptr;
+  }
+  PyObject* out = PyBytes_FromStringAndSize(nullptr, (Py_ssize_t)out_len);
+  if (!out) {
+    PyBuffer_Release(&in);
+    return nullptr;
+  }
+  int status = snappy_uncompress(static_cast<const char*>(in.buf), (size_t)in.len,
+                                 PyBytes_AS_STRING(out), &out_len);
+  PyBuffer_Release(&in);
+  if (status != 0) {
+    Py_DECREF(out);
+    PyErr_SetString(CodecError, "corrupt snappy data");
+    return nullptr;
+  }
+  return out;
+}
+
+static PyMethodDef codec_methods[] = {
+    {"encode_frame", codec_encode_frame, METH_VARARGS,
+     "encode_frame(body, compression=0) -> framed bytes"},
+    {"decode_frames", codec_decode_frames, METH_VARARGS,
+     "decode_frames(buf) -> ([(body, compression)], consumed)"},
+    {"compress", codec_compress, METH_VARARGS, "snappy compress"},
+    {"uncompress", codec_uncompress, METH_VARARGS, "snappy uncompress"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+static struct PyModuleDef codec_module = {
+    PyModuleDef_HEAD_INIT, "_codec",
+    "Native wire codec (framing + snappy) for channeld-tpu.", -1,
+    codec_methods,
+};
+
+PyMODINIT_FUNC PyInit__codec(void) {
+  PyObject* m = PyModule_Create(&codec_module);
+  if (!m) return nullptr;
+  CodecError = PyErr_NewException("channeld_tpu.native._codec.CodecError",
+                                  PyExc_ValueError, nullptr);
+  Py_INCREF(CodecError);
+  if (PyModule_AddObject(m, "CodecError", CodecError) < 0) {
+    Py_DECREF(CodecError);
+    Py_DECREF(m);
+    return nullptr;
+  }
+  return m;
+}

@@ -1,0 +1,188 @@
+"""gRPC sidecar exposing the TPU spatial decision plane.
+
+Lets an external gateway (e.g. the original Go channeld behind its
+SpatialController seam) offload the per-tick AOI/handover/fan-out pass:
+it ships position deltas + query/subscription changes in a StepRequest
+and receives compacted decisions. Service wiring is hand-rolled generic
+handlers because the image carries only the grpc runtime (no codegen
+plugin); the message schema is service.proto.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent import futures
+from typing import Optional
+
+import numpy as np
+
+from ..utils.logger import get_logger
+from .service_pb2 import (
+    ConfigRequest,
+    Empty,
+    StepRequest,
+    StepResponse,
+)
+
+logger = get_logger("ops.service")
+
+SERVICE_NAME = "chtpu.ops.SpatialDecision"
+
+
+class SpatialDecisionServicer:
+    def __init__(self):
+        self.engine = None
+        self._lock = threading.Lock()
+
+    # ---- rpc handlers ------------------------------------------------
+
+    def configure(self, request: ConfigRequest, context) -> Empty:
+        from .engine import SpatialEngine
+        from .spatial_ops import GridSpec
+
+        with self._lock:
+            self.engine = SpatialEngine(
+                GridSpec(
+                    offset_x=request.worldOffsetX,
+                    offset_z=request.worldOffsetZ,
+                    cell_w=request.gridWidth,
+                    cell_h=request.gridHeight,
+                    cols=request.gridCols,
+                    rows=request.gridRows,
+                ),
+                entity_capacity=request.entityCapacity or (1 << 17),
+                query_capacity=request.queryCapacity or (1 << 12),
+                sub_capacity=request.subCapacity or (1 << 16),
+            )
+        logger.info(
+            "configured engine: %dx%d grid, %d entity slots",
+            request.gridCols, request.gridRows, request.entityCapacity or (1 << 17),
+        )
+        return Empty()
+
+    def step(self, request: StepRequest, context) -> StepResponse:
+        with self._lock:
+            if self.engine is None:
+                import grpc
+
+                context.abort(grpc.StatusCode.FAILED_PRECONDITION, "not configured")
+            eng = self.engine
+            for up in request.updates:
+                eng.update_entity(up.entityId, up.x, up.y, up.z)
+            for eid in request.removedEntityIds:
+                eng.remove_entity(eid)
+            for q in request.queries:
+                eng.set_query(
+                    q.connId, q.kind, (q.centerX, q.centerZ),
+                    (q.extentX, q.extentZ), (q.dirX or 1.0, q.dirZ), q.angle,
+                )
+            for conn_id in request.removedQueryConnIds:
+                eng.remove_query(conn_id)
+            sub_map = getattr(eng, "_service_sub_map", None)
+            if sub_map is None:
+                sub_map = eng._service_sub_map = {}
+            for sub in request.addSubscriptions:
+                sub_map[sub.subId] = eng.add_subscription(
+                    sub.fanOutIntervalMs, sub.firstDueMs
+                )
+            for sub_id in request.removeSubIds:
+                slot = sub_map.pop(sub_id, None)
+                if slot is not None:
+                    eng.remove_subscription(slot)
+
+            now_ms = request.nowMs or eng.now_ms()
+            result = eng.tick(now_ms)
+
+            resp = StepResponse(engineNowMs=now_ms)
+            resp.handoverCount = int(result["handover_count"])
+            for entity_id, src, dst in eng.handover_list(result):
+                resp.handovers.add(entityId=entity_id, srcCell=src, dstCell=dst)
+            resp.cellCounts.extend(
+                np.asarray(result["cell_counts"]).astype(np.uint32).tolist()
+            )
+            interest = np.asarray(result["interest"])
+            dist = np.asarray(result["dist"])
+            for conn_id, row in eng._q_of_conn.items():
+                cells = np.nonzero(interest[row])[0]
+                ir = resp.interests.add(connId=conn_id)
+                ir.cells.extend(cells.astype(np.uint32).tolist())
+                ir.dists.extend(dist[row][cells].astype(np.uint32).tolist())
+            due = np.unpackbits(np.asarray(result["due_packed"]))
+            slot_to_sub = {slot: sub_id for sub_id, slot in sub_map.items()}
+            for slot in np.nonzero(due[: eng.sub_capacity])[0]:
+                sub_id = slot_to_sub.get(int(slot))
+                if sub_id is not None:
+                    resp.dueSubIds.append(sub_id)
+            return resp
+
+
+def create_server(port: int = 50051, max_workers: int = 4):
+    """Build (but don't start) the gRPC server; returns (server, servicer)."""
+    import grpc
+
+    servicer = SpatialDecisionServicer()
+    handlers = grpc.method_handlers_generic_handler(
+        SERVICE_NAME,
+        {
+            "Configure": grpc.unary_unary_rpc_method_handler(
+                servicer.configure,
+                request_deserializer=ConfigRequest.FromString,
+                response_serializer=Empty.SerializeToString,
+            ),
+            "Step": grpc.unary_unary_rpc_method_handler(
+                servicer.step,
+                request_deserializer=StepRequest.FromString,
+                response_serializer=StepResponse.SerializeToString,
+            ),
+        },
+    )
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server.add_generic_rpc_handlers((handlers,))
+    server.add_insecure_port(f"[::]:{port}")
+    return server, servicer
+
+
+class SpatialDecisionClient:
+    """Typed client for gateways written in Python (external gateways use
+    the proto schema directly)."""
+
+    def __init__(self, target: str = "127.0.0.1:50051"):
+        import grpc
+
+        self._channel = grpc.insecure_channel(target)
+        self._configure = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/Configure",
+            request_serializer=ConfigRequest.SerializeToString,
+            response_deserializer=Empty.FromString,
+        )
+        self._step = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/Step",
+            request_serializer=StepRequest.SerializeToString,
+            response_deserializer=StepResponse.FromString,
+        )
+
+    def configure(self, **kwargs) -> None:
+        self._configure(ConfigRequest(**kwargs))
+
+    def step(self, request: StepRequest) -> StepResponse:
+        return self._step(request)
+
+    def close(self) -> None:
+        self._channel.close()
+
+
+def main() -> None:
+    import argparse
+
+    p = argparse.ArgumentParser(description="channeld-tpu spatial decision sidecar")
+    p.add_argument("--port", type=int, default=50051)
+    args = p.parse_args()
+    server, _ = create_server(args.port)
+    server.start()
+    logger.info("spatial decision sidecar listening on :%d", args.port)
+    server.wait_for_termination()
+
+
+if __name__ == "__main__":
+    main()

@@ -22,6 +22,11 @@ from typing import Optional
 from . import snappy
 from .wire_pb2 import Packet
 
+try:  # native C++ codec (scripts/build_native.sh); None -> pure Python
+    from ..native import codec as _native
+except ImportError:
+    _native = None
+
 HEADER_SIZE = 5
 MAX_PACKET_SIZE = 0xFFFF
 _MAGIC0 = 0x43  # 'C'
@@ -34,6 +39,11 @@ class FramingError(Exception):
 
 def encode_frame(body: bytes, compression: int = 0) -> bytes:
     """Wrap a serialized Packet into one wire frame."""
+    if _native is not None:
+        try:
+            return _native.encode_frame(body, compression)
+        except _native.CodecError as e:
+            raise FramingError(str(e)) from None
     if compression == 1:
         compressed = snappy.compress(body)
         # Fall back to raw when compression doesn't help (and to keep the
@@ -70,6 +80,21 @@ class FrameDecoder:
         # Eager, not a generator: data must land in the buffer even when
         # the caller discards the return value (no frames yet).
         self._buf.extend(data)
+        if _native is not None:
+            try:
+                # bytearray passes the buffer protocol: no copy.
+                frames, consumed = _native.decode_frames(self._buf)
+            except _native.CodecError as e:
+                raise FramingError(str(e)) from None
+            del self._buf[:consumed]
+            if self._buf:
+                self.fragmented_count += 1
+            out = []
+            for body, ct in frames:
+                if ct == 1:
+                    self.peer_compression = 1
+                out.append(body)
+            return out
         out: list[bytes] = []
         while True:
             body = self._next_frame()

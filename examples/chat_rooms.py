@@ -1,0 +1,76 @@
+"""Chat-rooms example: the minimum end-to-end slice (SURVEY §7 stage 4).
+
+A GLOBAL-channel chat service (ref: examples/chat-rooms/main.go): clients
+connect over WebSocket or TCP, every ChannelDataUpdate merges into the
+chat history with the time-span-limited list merge, and subscribers
+receive fan-outs on their own cadence.
+
+Run:    python examples/chat_rooms.py [-ca :12108] [-cn ws]
+Client: python examples/sim_clients.py --behavior chat
+"""
+
+import asyncio
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from channeld_tpu.core import events
+from channeld_tpu.core.channel import get_global_channel, init_channels
+from channeld_tpu.core.connection import init_connections
+from channeld_tpu.core.ddos import init_anti_ddos, unauth_reaper_loop
+from channeld_tpu.core.server import flush_loop, start_listening
+from channeld_tpu.core.settings import global_settings
+from channeld_tpu.core.types import ConnectionType
+from channeld_tpu.models.chat import ChatChannelData, register_chat_types
+from channeld_tpu.protocol import control_pb2
+from channeld_tpu.utils.logger import init_logs
+
+
+async def main(argv) -> None:
+    global_settings.parse_flags(argv)
+    # Chat rooms don't run a master server: clients connect immediately.
+    global_settings.client_network_wait_master_server = False
+    init_logs(development=global_settings.development)
+    init_connections(
+        global_settings.server_fsm,
+        # Chat clients update the channel data themselves (ref:
+        # examples/chat-rooms/main.go:72 uses the client-authoritative FSM).
+        "config/client_authoritative_fsm.json",
+    )
+    register_chat_types()
+    init_channels()
+    init_anti_ddos()
+
+    # Seed the GLOBAL channel with chat data + merge options
+    # (ref: examples/chat-rooms/main.go channel data setup).
+    gch = get_global_channel()
+    gch.init_data(
+        ChatChannelData(),
+        control_pb2.ChannelDataMergeOptions(listSizeLimit=100, truncateTop=True),
+    )
+
+    tasks = [
+        asyncio.ensure_future(flush_loop()),
+        asyncio.ensure_future(unauth_reaper_loop()),
+    ]
+    await start_listening(
+        ConnectionType.SERVER,
+        global_settings.server_network,
+        global_settings.server_address,
+    )
+    await start_listening(
+        ConnectionType.CLIENT,
+        global_settings.client_network,
+        global_settings.client_address,
+    )
+    print(f"chat-rooms up: clients on {global_settings.client_network} "
+          f"{global_settings.client_address}", flush=True)
+    await asyncio.gather(*tasks)
+
+
+if __name__ == "__main__":
+    try:
+        asyncio.run(main(sys.argv[1:]))
+    except KeyboardInterrupt:
+        pass

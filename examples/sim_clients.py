@@ -1,0 +1,151 @@
+"""Sim-clients: the probabilistic load generator
+(ref: examples/sim-clients/main.go:36-160).
+
+Each simulated client runs a scheduler of weighted actions with
+per-action minimum intervals — the same driver model the reference uses
+for its benchmark configs. Behaviors:
+
+  chat   — authenticate, then post chat lines into the GLOBAL channel
+  tanks  — authenticate, move an entity around, stream transform updates
+
+Run:  python examples/sim_clients.py --addr 127.0.0.1:12108 -n 64 \
+          --behavior chat --duration 10
+"""
+
+import argparse
+import os
+import random
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from channeld_tpu.client import Client
+from channeld_tpu.core.types import BroadcastType, MessageType
+from channeld_tpu.models import chat_pb2, sim_pb2
+from channeld_tpu.protocol import control_pb2
+from channeld_tpu.utils.anyutil import pack_any
+
+
+class Action:
+    """Weighted action with a minimum interval (ref: main.go clientAction)."""
+
+    def __init__(self, name, probability, min_interval, run):
+        self.name = name
+        self.probability = probability
+        self.min_interval = min_interval
+        self.run = run
+        self.last = 0.0
+
+
+def run_client(index: int, args, stats: dict, lock: threading.Lock) -> None:
+    try:
+        client = Client(args.addr)
+    except OSError as e:
+        print(f"client {index}: dial failed: {e}", file=sys.stderr)
+        return
+    client.auth(pit=f"sim{index}")
+    end = time.time() + 3
+    while client.id == 0 and time.time() < end:
+        client.tick(timeout=0.05)
+    if client.id == 0:
+        print(f"client {index}: auth timed out", file=sys.stderr)
+        return
+
+    received = [0]
+    client.add_message_handler(
+        MessageType.CHANNEL_DATA_UPDATE,
+        lambda c, ch, m: received.__setitem__(0, received[0] + 1),
+    )
+    # Subscribe to GLOBAL with write access: chat/tanks clients post their
+    # own updates (client-authoritative mode).
+    client.send(
+        0, BroadcastType.NO_BROADCAST, MessageType.SUB_TO_CHANNEL,
+        control_pb2.SubscribedToChannelMessage(
+            connId=client.id,
+            subOptions=control_pb2.ChannelSubscriptionOptions(
+                fanOutIntervalMs=50, dataAccess=2,  # WRITE_ACCESS
+            ),
+        ),
+    )
+
+    sent = [0]
+
+    def send_chat():
+        data = chat_pb2.ChatChannelData()
+        m = data.chatMessages.add()
+        m.sender = f"sim{index}"
+        m.sendTime = int(time.time() * 1000)
+        m.content = f"hello #{sent[0]}"
+        client.send(
+            0, BroadcastType.NO_BROADCAST, MessageType.CHANNEL_DATA_UPDATE,
+            control_pb2.ChannelDataUpdateMessage(data=pack_any(data)),
+        )
+        sent[0] += 1
+
+    pos = [random.uniform(-1000, 1000), 0.0, random.uniform(-1000, 1000)]
+
+    def send_move():
+        pos[0] += random.uniform(-50, 50)
+        pos[2] += random.uniform(-50, 50)
+        data = sim_pb2.SimEntityChannelData()
+        data.state.entityId = 0x80000 + index
+        data.state.transform.position.x = pos[0]
+        data.state.transform.position.z = pos[2]
+        client.send(
+            0, BroadcastType.NO_BROADCAST, MessageType.CHANNEL_DATA_UPDATE,
+            control_pb2.ChannelDataUpdateMessage(data=pack_any(data)),
+        )
+        sent[0] += 1
+
+    actions = (
+        [Action("chat", 0.3, 0.5, send_chat)]
+        if args.behavior == "chat"
+        else [Action("move", 1.0, 0.1, send_move)]
+    )
+
+    deadline = time.time() + args.duration
+    while time.time() < deadline:
+        now = time.time()
+        for action in actions:
+            if now - action.last >= action.min_interval and random.random() < action.probability:
+                action.run()
+                action.last = now
+        client.tick(timeout=0.02)
+    client.disconnect()
+    with lock:
+        stats["sent"] += sent[0]
+        stats["received"] += received[0]
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--addr", default="127.0.0.1:12108")
+    p.add_argument("-n", "--num-clients", type=int, default=8)
+    p.add_argument("--behavior", choices=("chat", "tanks"), default="chat")
+    p.add_argument("--duration", type=float, default=10.0)
+    args = p.parse_args()
+
+    stats = {"sent": 0, "received": 0}
+    lock = threading.Lock()
+    threads = [
+        threading.Thread(target=run_client, args=(i, args, stats, lock), daemon=True)
+        for i in range(args.num_clients)
+    ]
+    t0 = time.time()
+    for t in threads:
+        t.start()
+        time.sleep(0.01)
+    for t in threads:
+        t.join()
+    dt = time.time() - t0
+    print(
+        f"{args.num_clients} clients, {args.duration}s: "
+        f"sent {stats['sent']} updates ({stats['sent']/dt:.0f}/s), "
+        f"received {stats['received']} fan-outs ({stats['received']/dt:.0f}/s)"
+    )
+
+
+if __name__ == "__main__":
+    main()

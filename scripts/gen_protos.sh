@@ -7,5 +7,7 @@ protoc -I. -I/usr/include --python_out=. \
     channeld_tpu/protocol/spatial.proto \
     channeld_tpu/protocol/replay.proto \
     channeld_tpu/models/testdata.proto \
-    channeld_tpu/models/sim.proto
+    channeld_tpu/models/sim.proto \
+    channeld_tpu/models/chat.proto \
+    channeld_tpu/ops/service.proto
 echo "generated: channeld_tpu/protocol/*_pb2.py"

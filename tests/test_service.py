@@ -1,0 +1,50 @@
+"""gRPC sidecar: configure + step round trip over a real socket."""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def sidecar():
+    from channeld_tpu.ops.service import SpatialDecisionClient, create_server
+
+    server, servicer = create_server(port=0)
+    import grpc
+
+    port = server.add_insecure_port("127.0.0.1:0")
+    server.start()
+    client = SpatialDecisionClient(f"127.0.0.1:{port}")
+    yield client, servicer
+    client.close()
+    server.stop(None)
+
+
+def test_sidecar_step_roundtrip(sidecar):
+    from channeld_tpu.ops.service_pb2 import StepRequest
+
+    client, servicer = sidecar
+    client.configure(
+        worldOffsetX=-150, worldOffsetZ=-150, gridWidth=100, gridHeight=100,
+        gridCols=3, gridRows=3, entityCapacity=64, queryCapacity=8,
+        subCapacity=8,
+    )
+    req = StepRequest(nowMs=10)
+    req.updates.add(entityId=0x80001, x=-100, y=0, z=-100)  # cell 0
+    req.updates.add(entityId=0x80002, x=0, y=0, z=0)  # cell 4
+    q = req.queries.add(connId=5, kind=1, centerX=0, centerZ=0, extentX=40)
+    s = req.addSubscriptions.add(subId=77, fanOutIntervalMs=50)
+    resp = client.step(req)
+    assert resp.handoverCount == 0
+    assert list(resp.cellCounts)[0] == 1 and list(resp.cellCounts)[4] == 1
+    interests = {ir.connId: dict(zip(ir.cells, ir.dists)) for ir in resp.interests}
+    assert interests[5] == {4: 0}
+    assert list(resp.dueSubIds) == []  # first due at 50ms
+
+    # Move entity 1 across two cells; sub becomes due.
+    req2 = StepRequest(nowMs=80)
+    req2.updates.add(entityId=0x80001, x=100, y=0, z=-100)  # cell 2
+    resp2 = client.step(req2)
+    assert resp2.handoverCount == 1
+    assert (resp2.handovers[0].entityId, resp2.handovers[0].srcCell,
+            resp2.handovers[0].dstCell) == (0x80001, 0, 2)
+    assert list(resp2.dueSubIds) == [77]
