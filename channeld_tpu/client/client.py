@@ -56,10 +56,19 @@ class Client:
         self._stub_callbacks: dict[int, MessageHandler] = {0: lambda c, ch, m: None}
         self._next_stub = 1
 
+        self._rudp = None
         if addr.startswith("ws"):
             import websockets.sync.client as ws_client
 
             self._ws = ws_client.connect(addr, max_size=1 << 20)
+            self._sock = None
+        elif addr.startswith(("rudp://", "kcp://")):
+            from ..core.rudp import RudpClient
+
+            netloc = urlparse(addr).netloc
+            host, _, port = netloc.rpartition(":")
+            self._rudp = RudpClient(host or "127.0.0.1", int(port), connect_timeout)
+            self._ws = None
             self._sock = None
         else:
             if "://" in addr:
@@ -237,12 +246,23 @@ class Client:
 
     def _write_packet(self, packet: wire_pb2.Packet) -> None:
         frame = encode_frame(packet.SerializeToString(), int(self.compression_type))
-        if self._ws is not None:
-            self._ws.send(frame)
-        else:
-            self._sock.sendall(frame)
+        try:
+            if self._rudp is not None:
+                self._rudp.send(frame)
+            elif self._ws is not None:
+                self._ws.send(frame)
+            else:
+                self._sock.sendall(frame)
+        except Exception:
+            # BrokenPipe / ConnectionClosed / ICMP unreachable: peer is gone.
+            self.connected = False
 
     def _read(self, timeout: float) -> bytes:
+        if self._rudp is not None:
+            data = self._rudp.recv(timeout)
+            if self._rudp.session.closed:
+                self.connected = False
+            return data
         if self._ws is not None:
             try:
                 msg = self._ws.recv(timeout=timeout)
@@ -288,7 +308,9 @@ class Client:
     def disconnect(self) -> None:
         self.connected = False
         try:
-            if self._ws is not None:
+            if self._rudp is not None:
+                self._rudp.close()
+            elif self._ws is not None:
                 self._ws.close()
             else:
                 self._sock.close()

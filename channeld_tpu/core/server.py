@@ -120,6 +120,40 @@ async def start_listening(conn_type: ConnectionType, network: str, addr: str):
         server = await websockets.serve(on_ws, host, port, max_size=1 << 20)
         logger.info("listening for %s on ws %s:%d", conn_type.name, host, port)
         return server
+    elif network in ("rudp", "kcp"):
+        from .rudp import RudpServerProtocol, RudpSession
+
+        class RudpTransport:
+            def __init__(self, session: RudpSession, addr):
+                self.session = session
+                self.addr = addr
+
+            def write(self, data: bytes) -> None:
+                self.session.send_stream(data)
+
+            def close(self) -> None:
+                self.session.fin()
+
+            def remote_addr(self):
+                return self.addr
+
+        def on_session(session: RudpSession, addr) -> None:
+            try:
+                conn = add_connection(RudpTransport(session, addr), conn_type)
+            except ConnectionRefusedError:
+                session.fin()
+                return
+            session.on_stream = conn.on_bytes
+            # FIN / peer loss must close the gateway connection like the
+            # TCP/WS reactors do (recovery depends on this close event).
+            session.on_close = lambda: conn.close(unexpected=True)
+
+        loop = asyncio.get_running_loop()
+        transport, protocol = await loop.create_datagram_endpoint(
+            lambda: RudpServerProtocol(on_session), local_addr=(host, port)
+        )
+        logger.info("listening for %s on rudp %s:%d", conn_type.name, host, port)
+        return protocol
     raise ValueError(f"unsupported network type: {network}")
 
 

@@ -73,9 +73,12 @@ class SpatialDecisionServicer:
             for eid in request.removedEntityIds:
                 eng.remove_entity(eid)
             for q in request.queries:
+                direction = (q.dirX, q.dirZ)
+                if direction == (0.0, 0.0):
+                    direction = (1.0, 0.0)  # unset; a zero vector is invalid
                 eng.set_query(
                     q.connId, q.kind, (q.centerX, q.centerZ),
-                    (q.extentX, q.extentZ), (q.dirX or 1.0, q.dirZ), q.angle,
+                    (q.extentX, q.extentZ), direction, q.angle,
                 )
             for conn_id in request.removedQueryConnIds:
                 eng.remove_query(conn_id)
@@ -139,8 +142,10 @@ def create_server(port: int = 50051, max_workers: int = 4):
     )
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
     server.add_generic_rpc_handlers((handlers,))
-    server.add_insecure_port(f"[::]:{port}")
-    return server, servicer
+    bound = server.add_insecure_port(f"[::]:{port}")
+    if bound == 0:
+        raise OSError(f"failed to bind sidecar port {port}")
+    return server, servicer, bound
 
 
 class SpatialDecisionClient:
@@ -178,9 +183,9 @@ def main() -> None:
     p = argparse.ArgumentParser(description="channeld-tpu spatial decision sidecar")
     p.add_argument("--port", type=int, default=50051)
     args = p.parse_args()
-    server, _ = create_server(args.port)
+    server, _, bound = create_server(args.port)
     server.start()
-    logger.info("spatial decision sidecar listening on :%d", args.port)
+    logger.info("spatial decision sidecar listening on :%d", bound)
     server.wait_for_termination()
 
 

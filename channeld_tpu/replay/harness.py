@@ -130,9 +130,8 @@ class ReplayClient:
             logger.error("replay connection %d failed to dial: %s", index, e)
             return
         received = [0]
-        client.set_message_entry(
+        client.add_message_handler(
             MessageType.CHANNEL_DATA_UPDATE,
-            type(client._message_map[MessageType.CHANNEL_DATA_UPDATE].template()),
             lambda c, ch, m: received.__setitem__(0, received[0] + 1),
         )
         authed = [False]

@@ -1,0 +1,140 @@
+"""Engine adapter: spawn routing, destroy, recovery extension
+(ref: pkg/unreal/message.go, recovery.go)."""
+
+import pytest
+
+from channeld_tpu.core.channel import create_entity_channel, get_channel
+from channeld_tpu.core.message import MESSAGE_MAP, MessageContext
+from channeld_tpu.core.subscription import subscribe_to_channel
+from channeld_tpu.core.types import ChannelType, ConnectionType, MessageType
+from channeld_tpu.models import sim_pb2
+from channeld_tpu.models.engine_adapter import (
+    MSG_DESTROY,
+    MSG_SPAWN,
+    RecoverableChannelDataExtension,
+    check_entity_handover,
+    init_message_handlers,
+)
+from channeld_tpu.models.sim import register_sim_types
+from channeld_tpu.protocol import control_pb2, wire_pb2
+from channeld_tpu.spatial.controller import set_spatial_controller
+from channeld_tpu.spatial.grid import StaticGrid2DSpatialController
+
+from helpers import StubConnection, fresh_runtime
+
+START = 0x10000
+E = 0x80000
+
+
+@pytest.fixture(autouse=True)
+def runtime():
+    gch = fresh_runtime()
+    register_sim_types()
+    init_message_handlers()
+    yield gch
+
+
+def spawn_forward(net_id, x=None, z=None, channel_id=0, conn_id=0):
+    spawn = sim_pb2.SpawnObjectMessage(channelId=channel_id)
+    spawn.obj.netId = net_id
+    spawn.obj.owningConnId = conn_id
+    if x is not None:
+        spawn.location.x = x
+        spawn.location.z = z
+    return wire_pb2.ServerForwardMessage(payload=spawn.SerializeToString())
+
+
+def make_spatial_world():
+    ctl = StaticGrid2DSpatialController()
+    ctl.load_config(dict(WorldOffsetX=0, WorldOffsetZ=0, GridWidth=100,
+                         GridHeight=100, GridCols=2, GridRows=1, ServerCols=2,
+                         ServerRows=1, ServerInterestBorderSize=1))
+    set_spatial_controller(ctl)
+    servers = []
+    for i in range(2):
+        server = StubConnection(10 + i, ConnectionType.SERVER)
+        ctx = MessageContext(
+            msg_type=MessageType.CREATE_CHANNEL,
+            msg=control_pb2.CreateChannelMessage(),
+            connection=server,
+        )
+        for ch in ctl.create_channels(ctx):
+            subscribe_to_channel(server, ch, None)
+        servers.append(server)
+    return ctl, servers
+
+
+def test_spawn_rewrites_spatial_channel_and_inserts_entity():
+    ctl, (server_a, server_b) = make_spatial_world()
+    net_id = E + 31
+    # Spawn at x=150 (cell 1) but addressed to cell 0: must be re-routed.
+    ctx = MessageContext(
+        msg_type=MSG_SPAWN,
+        msg=spawn_forward(net_id, x=150.0, z=50.0, channel_id=START),
+        connection=server_a,
+        channel=get_channel(START),
+        channel_id=START,
+    )
+    MESSAGE_MAP[MSG_SPAWN].handler(ctx)
+    dst = get_channel(START + 1)
+    dst.tick_once(0)  # run the queued execute + forward
+    assert net_id in dst.get_data_message().entities
+    assert net_id not in get_channel(START).get_data_message().entities
+    # The forward went to the dst channel's owner.
+    forwards = [c for c in server_b.sent if c.msg_type == MSG_SPAWN]
+    assert len(forwards) == 1
+
+
+def test_spawn_without_location_records_for_recovery():
+    from channeld_tpu.core.channel import create_channel
+
+    owner = StubConnection(1, ConnectionType.SERVER)
+    ch = create_channel(ChannelType.SUBWORLD, owner)
+    ch.init_data(None, None)
+    assert isinstance(ch.data.extension, RecoverableChannelDataExtension)
+    net_id = E + 32
+    ctx = MessageContext(
+        msg_type=MSG_SPAWN,
+        msg=spawn_forward(net_id, conn_id=7),
+        connection=owner,
+        channel=ch,
+        channel_id=ch.id,
+    )
+    MESSAGE_MAP[MSG_SPAWN].handler(ctx)
+    assert net_id in ch.data.extension.spawned_objs
+    recovery_data = ch.data.extension.get_recovery_data_message()
+    assert recovery_data.spawnedObjects[net_id].owningConnId == 7
+
+
+def test_destroy_removes_entity_and_channel():
+    ctl, (server_a, server_b) = make_spatial_world()
+    net_id = E + 33
+    entity_ch = create_entity_channel(net_id, server_a)
+    src = get_channel(START)
+    src.get_data_message().entities[net_id].entityId = net_id
+
+    ctx = MessageContext(
+        msg_type=MSG_DESTROY,
+        msg=wire_pb2.ServerForwardMessage(
+            payload=sim_pb2.DestroyObjectMessage(netId=net_id).SerializeToString()
+        ),
+        connection=server_a,
+        channel=src,
+        channel_id=START,
+    )
+    MESSAGE_MAP[MSG_DESTROY].handler(ctx)
+    assert net_id not in src.get_data_message().entities
+    assert get_channel(net_id) is None
+
+
+def test_check_entity_handover():
+    a = sim_pb2.Vec3(x=1, y=2, z=3)
+    b = sim_pb2.Vec3(x=1, y=2, z=3)
+    moved, old, new = check_entity_handover(1, a, b)
+    assert not moved
+    b2 = sim_pb2.Vec3(x=5, y=2, z=3)
+    moved, old, new = check_entity_handover(1, b2, a)
+    assert moved and new.x == 5 and old.x == 1
+    # UE axis swap: Z-up -> Y-up.
+    moved, old, new = check_entity_handover(1, b2, a, swap_yz=True)
+    assert new.y == 3 and new.z == 2

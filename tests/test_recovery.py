@@ -1,0 +1,213 @@
+"""Connection recovery (ref: pkg/channeld/connection_recovery.go + §5).
+
+A recoverable server connection drops unexpectedly; its subscriptions and
+ownership are stashed by PIT; a new connection authenticating with the
+same PIT reclaims the old connection id, gets re-subscribed with
+skipFirstFanOut, receives ChannelDataRecoveryMessage with the full data
+(+ extension payload) per channel, then RECOVERY_END; owner-lost/
+recovered broadcasts fire around it.
+"""
+
+import pytest
+
+from channeld_tpu.core import connection as connection_mod
+from channeld_tpu.core import connection_recovery as recovery
+from channeld_tpu.core.channel import create_channel, get_global_channel
+from channeld_tpu.core.connection import add_connection
+from channeld_tpu.core.fsm import MessageFsm
+from channeld_tpu.core.settings import global_settings
+from channeld_tpu.core.subscription import subscribe_to_channel
+from channeld_tpu.core.types import ChannelType, ConnectionType, MessageType
+from channeld_tpu.models import testdata_pb2
+from channeld_tpu.protocol import FrameDecoder, control_pb2, encode_packet, wire_pb2
+
+from helpers import FakeTransport, fresh_runtime
+
+AUTH_FSM = {
+    "States": [
+        {"Name": "INIT", "MsgTypeWhitelist": "1", "MsgTypeBlacklist": ""},
+        {"Name": "OPEN", "MsgTypeWhitelist": "2-65535", "MsgTypeBlacklist": ""},
+    ],
+    "Transitions": [],
+}
+
+
+@pytest.fixture(autouse=True)
+def runtime():
+    gch = fresh_runtime()
+    global_settings.development = True
+    global_settings.server_conn_recoverable = True
+    global_settings.get_channel_settings(
+        ChannelType.SUBWORLD
+    )  # defaults
+    global_settings.channel_settings[ChannelType.SUBWORLD] = (
+        global_settings.channel_settings[ChannelType.GLOBAL].__class__(
+            send_owner_lost_and_recovered=True
+        )
+    )
+    connection_mod.set_fsm_templates(
+        MessageFsm.from_dict(AUTH_FSM), MessageFsm.from_dict(AUTH_FSM)
+    )
+    yield gch
+
+
+def wire(msg_type, msg, ch=0):
+    return encode_packet(
+        wire_pb2.Packet(
+            messages=[
+                wire_pb2.MessagePack(
+                    channelId=ch, msgType=msg_type, msgBody=msg.SerializeToString()
+                )
+            ]
+        )
+    )
+
+
+def sent_types(t):
+    dec = FrameDecoder()
+    out = []
+    for chunk in t.written:
+        for p in dec.decode_packets(chunk):
+            out.extend(p.messages)
+    return out
+
+
+def test_server_connection_recovery_end_to_end():
+    gch = get_global_channel()
+
+    # Server authenticates and owns a SUBWORLD channel with data.
+    t1 = FakeTransport()
+    server = add_connection(t1, ConnectionType.SERVER)
+    server.on_bytes(
+        wire(MessageType.AUTH, control_pb2.AuthMessage(playerIdentifierToken="srv1"))
+    )
+    gch.tick_once(0)
+    ch = create_channel(ChannelType.SUBWORLD, server)
+    ch.init_data(testdata_pb2.TestChannelDataMessage(text="state", num=9), None)
+    subscribe_to_channel(server, ch, None)
+
+    # A client watches the channel (to observe owner-lost broadcasts).
+    t2 = FakeTransport()
+    watcher = add_connection(t2, ConnectionType.CLIENT)
+    watcher.on_bytes(
+        wire(MessageType.AUTH, control_pb2.AuthMessage(playerIdentifierToken="w"))
+    )
+    gch.tick_once(0)
+    subscribe_to_channel(watcher, ch, None)
+
+    old_conn_id = server.id
+
+    # The server connection dies unexpectedly.
+    server.close(unexpected=True)
+    assert server.recover_handle is not None
+    ch.tick_once(ch.get_time())  # tickConnections stashes the recoverable sub
+
+    assert "srv1" in ch.recoverable_subs
+    assert ch.get_owner() is None
+    watcher.flush()
+    lost = [m for m in sent_types(t2) if m.msgType == MessageType.CHANNEL_OWNER_LOST]
+    assert len(lost) == 1
+
+    # New connection re-authenticates with the same PIT.
+    t3 = FakeTransport()
+    server2 = add_connection(t3, ConnectionType.SERVER)
+    server2.on_bytes(
+        wire(MessageType.AUTH, control_pb2.AuthMessage(playerIdentifierToken="srv1"))
+    )
+    gch.tick_once(0)
+    server2.flush()
+
+    # Previous connection id reclaimed (ref: RecoverFromHandle).
+    assert server2.id == old_conn_id
+    assert server2.should_recover()
+    auth_results = [m for m in sent_types(t3) if m.msgType == MessageType.AUTH]
+    result = control_pb2.AuthResultMessage()
+    result.ParseFromString(auth_results[0].msgBody)
+    assert result.shouldRecover is True
+
+    # The channel tick restores ownership + subscription and streams the
+    # recovery data.
+    ch.tick_once(ch.get_time())
+    assert ch.get_owner() is server2
+    assert server2 in ch.subscribed_connections
+    assert ch.subscribed_connections[server2].options.skipFirstFanOut is True
+
+    server2.flush()
+    msgs = sent_types(t3)
+    rec = [m for m in msgs if m.msgType == MessageType.RECOVERY_CHANNEL_DATA]
+    assert len(rec) == 1
+    rmsg = control_pb2.ChannelDataRecoveryMessage()
+    rmsg.ParseFromString(rec[0].msgBody)
+    assert rmsg.channelId == ch.id
+    assert rmsg.ownerConnId == server2.id
+    data = testdata_pb2.TestChannelDataMessage()
+    rmsg.channelData.Unpack(data)
+    assert data.text == "state" and data.num == 9
+
+    # After the recovery window, RECOVERY_END arrives.
+    recovery.CHANNEL_DATA_RECOVERY_TIMEOUT = 0.0
+    try:
+        recovery.tick_connection_recovery_once()
+    finally:
+        recovery.CHANNEL_DATA_RECOVERY_TIMEOUT = 1.0
+    server2.flush()
+    ends = [m for m in sent_types(t3) if m.msgType == MessageType.RECOVERY_END]
+    assert len(ends) == 1
+    assert server2.recover_handle is None
+
+
+def test_recovery_timeout_reaps_handle():
+    t1 = FakeTransport()
+    server = add_connection(t1, ConnectionType.SERVER)
+    server.on_bytes(
+        wire(MessageType.AUTH, control_pb2.AuthMessage(playerIdentifierToken="srv2"))
+    )
+    get_global_channel().tick_once(0)
+    global_settings.server_conn_recover_timeout_ms = 1
+    server.close(unexpected=True)
+    handle = recovery.get_recover_handle("srv2")
+    assert handle is not None
+    handle.disconn_time -= 10  # pretend it died 10s ago
+    recovery.tick_connection_recovery_once()
+    assert recovery.get_recover_handle("srv2") is None
+
+
+def test_client_messages_dropped_while_owner_recovering():
+    """(ref: message.go:72-80)."""
+    from channeld_tpu.core.message import (
+        MessageContext,
+        handle_client_to_server_user_message,
+    )
+
+    gch = get_global_channel()
+    t1 = FakeTransport()
+    server = add_connection(t1, ConnectionType.SERVER)
+    server.on_bytes(
+        wire(MessageType.AUTH, control_pb2.AuthMessage(playerIdentifierToken="srv3"))
+    )
+    gch.tick_once(0)
+    ch = create_channel(ChannelType.SUBWORLD, server)
+
+    server.close(unexpected=True)
+    t3 = FakeTransport()
+    server2 = add_connection(t3, ConnectionType.SERVER)
+    server2.on_bytes(
+        wire(MessageType.AUTH, control_pb2.AuthMessage(playerIdentifierToken="srv3"))
+    )
+    gch.tick_once(0)
+    ch.set_owner(server2)
+    assert server2.should_recover()
+
+    t4 = FakeTransport()
+    client = add_connection(t4, ConnectionType.CLIENT)
+    ctx = MessageContext(
+        msg_type=100,
+        msg=wire_pb2.ServerForwardMessage(clientConnId=client.id, payload=b"x"),
+        connection=client,
+        channel=ch,
+    )
+    t3.written.clear()
+    handle_client_to_server_user_message(ctx)
+    server2.flush()
+    # Dropped: the recovering owner got no forwarded user-space message.
+    assert [m for m in sent_types(t3) if m.msgType == 100] == []

@@ -8,10 +8,7 @@ import pytest
 def sidecar():
     from channeld_tpu.ops.service import SpatialDecisionClient, create_server
 
-    server, servicer = create_server(port=0)
-    import grpc
-
-    port = server.add_insecure_port("127.0.0.1:0")
+    server, servicer, port = create_server(port=0)
     server.start()
     client = SpatialDecisionClient(f"127.0.0.1:{port}")
     yield client, servicer
