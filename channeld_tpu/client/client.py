@@ -115,6 +115,10 @@ class Client:
             spatial_pb2.CreateSpatialChannelsResultMessage,
         )
         self.set_message_entry(
+            MessageType.CREATE_ENTITY_CHANNEL,
+            control_pb2.CreateChannelResultMessage,
+        )
+        self.set_message_entry(
             MessageType.SPATIAL_CHANNELS_READY, spatial_pb2.SpatialChannelsReadyMessage
         )
         self.set_message_entry(

@@ -394,11 +394,16 @@ def init_channels() -> None:
 
     from google.protobuf import symbol_database
 
-    for mod in global_settings.import_modules:
+    # Import data-type modules first (their generated protos must be in the
+    # symbol database), register the operator's explicit DataMsgFullName
+    # config next, and only then let module convention hooks fill the
+    # remaining defaults — explicit config always wins.
+    modules = []
+    for mod_name in global_settings.import_modules:
         try:
-            importlib.import_module(mod)
+            modules.append(importlib.import_module(mod_name))
         except ImportError:
-            logger.error("failed to import data-type module %s", mod)
+            logger.error("failed to import data-type module %s", mod_name)
 
     for ch_type, st in global_settings.channel_settings.items():
         if not st.data_msg_full_name:
@@ -411,6 +416,11 @@ def init_channels() -> None:
             )
             continue
         register_channel_data_type(ch_type, cls())
+
+    for mod in modules:
+        hook = getattr(mod, "register_channel_data_types", None)
+        if callable(hook):
+            hook()
 
 
 def get_channel(channel_id: int) -> Optional[Channel]:

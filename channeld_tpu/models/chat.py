@@ -52,3 +52,7 @@ def register_chat_types() -> None:
     register_channel_data_type(ChannelType.GLOBAL, ChatChannelData())
     register_channel_data_type(ChannelType.SUBWORLD, ChatChannelData())
     register_channel_data_type(ChannelType.PRIVATE, ChatChannelData())
+
+
+# -imports hook (see core.channel.init_channels)
+register_channel_data_types = register_chat_types

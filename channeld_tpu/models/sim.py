@@ -122,3 +122,7 @@ def register_sim_types() -> None:
     register_channel_data_type(ChannelType.ENTITY, SimEntityChannelData())
     register_channel_data_type(ChannelType.GLOBAL, SimGlobalChannelData())
     register_channel_data_type(ChannelType.SUBWORLD, SimGlobalChannelData())
+
+
+# -imports hook (see core.channel.init_channels)
+register_channel_data_types = register_sim_types

@@ -259,6 +259,21 @@ def spatial_step(
     interest, dist = aoi_masks(grid, queries)
     last_ms, interval_ms, active = sub_state
     due, new_last = fanout_due(now_ms, last_ms, interval_ms, active)
+    due_packed = jnp.packbits(due)
+    # Single host-consumption blob: one D2H transfer per tick instead of
+    # one per output (each transfer costs a dispatch + possibly a full
+    # transport round trip). Layout (i32):
+    #   [0]                count
+    #   [1 : 1+3K]         handover rows, row-major
+    #   [... : +C]         cell counts
+    #   [... : +ceil(S/32)] due bitmask words (u8-packed, zero-padded)
+    pad = (-due_packed.shape[0]) % 4
+    due_words = jax.lax.bitcast_convert_type(
+        jnp.pad(due_packed, (0, pad)).reshape(-1, 4), jnp.int32
+    ).reshape(-1)
+    consume = jnp.concatenate([
+        ho_count[None], ho_rows.reshape(-1), counts, due_words
+    ])
     return {
         "cell_of": cell_of,
         "committed_prev": committed_prev,
@@ -270,6 +285,21 @@ def spatial_step(
         "due": due,
         # Bit-packed due mask: 8x less D2H for the per-tick host readback
         # (unpack host-side with np.unpackbits).
-        "due_packed": jnp.packbits(due),
+        "due_packed": due_packed,
+        "consume": consume,
         "new_last_fanout_ms": new_last,
     }
+
+
+def parse_consume_blob(blob, max_handovers: int, num_cells: int, num_subs: int):
+    """Host-side split of the packed consumption blob (numpy)."""
+    import numpy as np
+
+    blob = np.asarray(blob)
+    count = int(blob[0])
+    rows_end = 1 + 3 * max_handovers
+    rows = blob[1:rows_end].reshape(max_handovers, 3)
+    counts = blob[rows_end : rows_end + num_cells]
+    due_words = blob[rows_end + num_cells :]
+    due = np.unpackbits(due_words.view(np.uint8))[:num_subs]
+    return count, rows, counts, due

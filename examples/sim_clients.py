@@ -39,6 +39,9 @@ class Action:
         self.last = 0.0
 
 
+SEEDED = threading.Event()
+
+
 def run_client(index: int, args, stats: dict, lock: threading.Lock) -> None:
     try:
         client = Client(args.addr)
@@ -58,6 +61,28 @@ def run_client(index: int, args, stats: dict, lock: threading.Lock) -> None:
         MessageType.CHANNEL_DATA_UPDATE,
         lambda c, ch, m: received.__setitem__(0, received[0] + 1),
     )
+
+    # The first client plays master server: claim GLOBAL and seed its data
+    # so updates have something to merge into (the reference gateway drops
+    # updates until the channel data is created).
+    if index == 0:
+        seed = (
+            chat_pb2.ChatChannelData()
+            if args.behavior == "chat"
+            else sim_pb2.SimSpatialChannelData()
+        )
+        client.send(
+            0, BroadcastType.NO_BROADCAST, MessageType.CREATE_CHANNEL,
+            control_pb2.CreateChannelMessage(channelType=1, data=pack_any(seed)),
+        )
+        try:
+            client.wait_for(MessageType.CREATE_CHANNEL, timeout=3)
+        except TimeoutError:
+            print("client 0: GLOBAL seeding timed out", file=sys.stderr)
+        finally:
+            SEEDED.set()
+    else:
+        SEEDED.wait(timeout=6)  # updates before seeding would be dropped
     # Subscribe to GLOBAL with write access: chat/tanks clients post their
     # own updates (client-authoritative mode).
     client.send(
@@ -89,10 +114,11 @@ def run_client(index: int, args, stats: dict, lock: threading.Lock) -> None:
     def send_move():
         pos[0] += random.uniform(-50, 50)
         pos[2] += random.uniform(-50, 50)
-        data = sim_pb2.SimEntityChannelData()
-        data.state.entityId = 0x80000 + index
-        data.state.transform.position.x = pos[0]
-        data.state.transform.position.z = pos[2]
+        data = sim_pb2.SimSpatialChannelData()
+        state = data.entities[0x80000 + index]
+        state.entityId = 0x80000 + index
+        state.transform.position.x = pos[0]
+        state.transform.position.z = pos[2]
         client.send(
             0, BroadcastType.NO_BROADCAST, MessageType.CHANNEL_DATA_UPDATE,
             control_pb2.ChannelDataUpdateMessage(data=pack_any(data)),

@@ -1,0 +1,159 @@
+"""Seamless open-world demo: master + N spatial servers + moving entities.
+
+The full spatial stack end to end (the reference's channeld-ue-tps
+topology, BASELINE config #5 shape): a master server owns GLOBAL, spatial
+servers allocate their grid blocks, entities spawn into cells and move;
+crossings hand the entities (and their channels) over between servers.
+
+Run the gateway first:
+
+    python -m channeld_tpu -dev -scc config/spatial_static_2x2.json \
+        -imports channeld_tpu.models.sim
+
+then:  python examples/spatial_world.py [--entities 32] [--duration 10]
+"""
+
+import argparse
+import os
+import random
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from channeld_tpu.client import Client
+from channeld_tpu.core.types import BroadcastType, MessageType
+from channeld_tpu.models import sim_pb2
+from channeld_tpu.protocol import control_pb2, spatial_pb2
+from channeld_tpu.utils.anyutil import pack_any
+
+ENTITY_START = 0x80000
+
+
+def run_spatial_server(index: int, args, stats: dict, lock) -> None:
+    server = Client(args.server_addr)
+    server.auth(pit=f"spatial{index}")
+    end = time.time() + 5
+    while server.id == 0 and time.time() < end:
+        server.tick(timeout=0.05)
+    assert server.id, f"spatial server {index} auth failed"
+
+    my_channels: list[int] = []
+    handovers = [0]
+    server.add_message_handler(
+        MessageType.CREATE_SPATIAL_CHANNEL,
+        lambda c, ch, m: my_channels.extend(m.spatialChannelId),
+    )
+    server.add_message_handler(
+        MessageType.CHANNEL_DATA_HANDOVER,
+        lambda c, ch, m: handovers.__setitem__(0, handovers[0] + 1),
+    )
+    ready = [False]
+    server.add_message_handler(
+        MessageType.SPATIAL_CHANNELS_READY,
+        lambda c, ch, m: ready.__setitem__(0, True),
+    )
+    server.send(
+        0, BroadcastType.NO_BROADCAST, MessageType.CREATE_SPATIAL_CHANNEL,
+        control_pb2.CreateChannelMessage(
+            channelType=4,
+            data=pack_any(sim_pb2.SimSpatialChannelData()),
+        ),
+    )
+    end = time.time() + 10
+    while not ready[0] and time.time() < end:
+        server.tick(timeout=0.05)
+    assert ready[0], f"server {index}: world never became ready"
+
+    # Spawn entities in my first authority cell and walk them around.
+    entities: dict[int, list] = {}
+    for i in range(args.entities_per_server):
+        eid = ENTITY_START + 1 + index * 1000 + i
+        x = random.uniform(-90, 90)
+        z = random.uniform(-90, 90)
+        data = sim_pb2.SimEntityChannelData()
+        data.state.entityId = eid
+        data.state.transform.position.x = x
+        data.state.transform.position.z = z
+        server.send(
+            0, BroadcastType.NO_BROADCAST, MessageType.CREATE_ENTITY_CHANNEL,
+            spatial_pb2.CreateEntityChannelMessage(
+                entityId=eid,
+                data=pack_any(data),
+                subOptions=control_pb2.ChannelSubscriptionOptions(dataAccess=2),
+            ),
+        )
+        entities[eid] = [x, z]
+    deadline = time.time() + args.duration
+    moves = 0
+    while time.time() < deadline:
+        for eid, pos in entities.items():
+            pos[0] += random.uniform(-15, 15)
+            pos[1] += random.uniform(-15, 15)
+            pos[0] = max(-99.0, min(99.0, pos[0]))
+            pos[1] = max(-99.0, min(99.0, pos[1]))
+            data = sim_pb2.SimEntityChannelData()
+            data.state.entityId = eid
+            data.state.transform.position.x = pos[0]
+            data.state.transform.position.z = pos[1]
+            server.send(
+                eid, BroadcastType.NO_BROADCAST, MessageType.CHANNEL_DATA_UPDATE,
+                control_pb2.ChannelDataUpdateMessage(data=pack_any(data)),
+            )
+            moves += 1
+        server.tick(timeout=0.02)
+        time.sleep(0.05)
+    server.tick(timeout=0.2)
+    with lock:
+        stats["moves"] += moves
+        stats["handovers"] += handovers[0]
+        stats["channels"] += len(my_channels)
+    server.disconnect()
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--server-addr", default="127.0.0.1:11288")
+    p.add_argument("--servers", type=int, default=4)
+    p.add_argument("--entities-per-server", type=int, default=8)
+    p.add_argument("--duration", type=float, default=10.0)
+    args = p.parse_args()
+
+    # Master server: owns GLOBAL so the client listener opens and entity
+    # ownership inference works.
+    master = Client(args.server_addr)
+    master.auth(pit="master")
+    end = time.time() + 5
+    while master.id == 0 and time.time() < end:
+        master.tick(timeout=0.05)
+    assert master.id, "master auth failed"
+    master.send(
+        0, BroadcastType.NO_BROADCAST, MessageType.CREATE_CHANNEL,
+        control_pb2.CreateChannelMessage(channelType=1),
+    )
+    master.tick(timeout=0.2)
+
+    stats = {"moves": 0, "handovers": 0, "channels": 0}
+    lock = threading.Lock()
+    threads = [
+        threading.Thread(
+            target=run_spatial_server, args=(i, args, stats, lock), daemon=True
+        )
+        for i in range(args.servers)
+    ]
+    for t in threads:
+        t.start()
+        time.sleep(0.1)
+    for t in threads:
+        t.join()
+    print(
+        f"{args.servers} spatial servers x {args.entities_per_server} entities, "
+        f"{args.duration}s: {stats['channels']} spatial channels, "
+        f"{stats['moves']} movement updates, "
+        f"{stats['handovers']} handover messages observed"
+    )
+
+
+if __name__ == "__main__":
+    main()
