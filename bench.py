@@ -43,6 +43,10 @@ def main() -> None:
         spatial_step,
     )
 
+    from channeld_tpu.ops.pallas_kernels import pallas_available
+
+    USE_PALLAS = pallas_available()
+
     # The reference benchmark world (spatial_static_benchmark.json).
     grid = GridSpec(offset_x=-15000.0, offset_z=-15000.0, cell_w=2000.0,
                     cell_h=2000.0, cols=15, rows=15)
@@ -87,6 +91,7 @@ def main() -> None:
         out = spatial_step(
             grid, new_pos, prev_cell, valid, queries,
             (sub_last, sub_interval, sub_active), MAX_HANDOVERS, now_ms,
+            use_pallas=USE_PALLAS,
         )
         return new_pos, velocities, out
 

@@ -82,6 +82,10 @@ class SpatialEngine:
 
         self._start = time.monotonic()
         self.last_result: Optional[dict] = None
+        # Fused Mosaic assign+count on TPU backends (pallas_kernels).
+        from .pallas_kernels import pallas_available
+
+        self.use_pallas = pallas_available()
 
     # ---- entity slots ----------------------------------------------------
 
@@ -227,6 +231,7 @@ class SpatialEngine:
             self._d_sub_state,
             self.max_handovers,
             jnp.int32(now_ms),
+            use_pallas=self.use_pallas,
         )
         # Baseline for the next tick: crossings that overflowed the handover
         # row budget keep their old cell so they are re-detected, not lost.
