@@ -34,6 +34,48 @@ from .types import BroadcastType, ChannelType, ConnectionType, GLOBAL_CHANNEL_ID
 
 logger = get_logger("channel")
 
+# Channels whose in-queues are above the high watermark. A reactor pauses
+# reading from a connection only while a channel *that connection* fed is
+# congested — the asyncio analog of the reference's blocking
+# `inMsgQueue <-` send, which paused exactly the sending connection's
+# recv goroutine (ref: channel.go:295-310).
+_congested_channels: set = set()
+_drain_event: Optional[asyncio.Event] = None
+QUEUE_CAPACITY = 4096
+_HIGH_WATERMARK = QUEUE_CAPACITY * 3 // 4
+_LOW_WATERMARK = QUEUE_CAPACITY // 4
+
+
+def is_congested() -> bool:
+    return bool(_congested_channels)
+
+
+def connection_congested(conn) -> bool:
+    """True while a channel this connection enqueued into is congested."""
+    pending = getattr(conn, "backpressure_channels", None)
+    if not pending:
+        return False
+    pending &= _congested_channels
+    conn.backpressure_channels = pending
+    return bool(pending)
+
+
+def _signal_drain() -> None:
+    if _drain_event is not None:
+        _drain_event.set()
+
+
+async def congestion_wait(conn) -> None:
+    """Await until the channels ``conn`` fed drain below the low mark."""
+    global _drain_event
+    if _drain_event is None:
+        _drain_event = asyncio.Event()
+    while connection_congested(conn):
+        _drain_event.clear()
+        if not connection_congested(conn):
+            break
+        await _drain_event.wait()
+
 
 class ChannelState(IntEnum):
     INIT = 0
@@ -58,7 +100,7 @@ class Channel:
         self.latest_data_update_conn_id = 0
         self.spatial_notifier = None
         self.entity_controller = None
-        self.in_msg_queue: asyncio.Queue = asyncio.Queue(maxsize=1024)
+        self.in_msg_queue: asyncio.Queue = asyncio.Queue(maxsize=QUEUE_CAPACITY)
         self.fan_out_queue: list[FanOutConnection] = []
         self.start_ns = time.monotonic_ns()
         st = global_settings.get_channel_settings(self.channel_type)
@@ -183,7 +225,20 @@ class Channel:
         try:
             self.in_msg_queue.put_nowait(qm)
         except asyncio.QueueFull:
+            # Watermark backpressure should make this unreachable; dropping
+            # is the last resort (the reference would block forever).
             self.logger.warning("in-queue full, dropping message")
+            return
+        if self.in_msg_queue.qsize() >= _HIGH_WATERMARK:
+            _congested_channels.add(self.id)
+            # Remember which connection fed the congested queue so only its
+            # reads pause (None for internal puts).
+            conn = getattr(qm.ctx, "connection", None) if qm.ctx else None
+            if conn is not None:
+                pending = getattr(conn, "backpressure_channels", None)
+                if pending is None:
+                    pending = conn.backpressure_channels = set()
+                pending.add(self.id)
 
     # ---- tick ------------------------------------------------------------
 
@@ -234,30 +289,40 @@ class Channel:
 
     def _tick_messages(self, tick_start: float) -> None:
         """Drain the queue within the tick budget (ref: channel.go:389-412)."""
-        while not self.in_msg_queue.empty():
-            qm = self.in_msg_queue.get_nowait()
-            # One bad message must never kill the channel task: isolate every
-            # handler (internal puts may carry no connection — e.g.
-            # RemoveChannel after owner loss — handlers guard themselves).
-            try:
-                qm.handler(qm.ctx)
-            except Exception:
-                self.logger.exception(
-                    "message handler failed (msgType=%s)",
-                    getattr(qm.ctx, "msg_type", None),
-                )
-                continue
-            if qm.ctx is None:
-                continue
+        try:
+            while not self.in_msg_queue.empty():
+                qm = self.in_msg_queue.get_nowait()
+                # One bad message must never kill the channel task: isolate
+                # every handler (internal puts may carry no connection —
+                # e.g. RemoveChannel after owner loss — handlers guard
+                # themselves).
+                try:
+                    qm.handler(qm.ctx)
+                except Exception:
+                    self.logger.exception(
+                        "message handler failed (msgType=%s)",
+                        getattr(qm.ctx, "msg_type", None),
+                    )
+                    continue
+                if qm.ctx is None:
+                    continue
+                if (
+                    self.tick_interval > 0
+                    and time.monotonic() - tick_start >= self.tick_interval
+                ):
+                    self.logger.warning(
+                        "spent too long handling messages; %d deferred to next tick",
+                        self.in_msg_queue.qsize(),
+                    )
+                    break
+        finally:
+            # Lift backpressure once the queue drained below the low mark.
             if (
-                self.tick_interval > 0
-                and time.monotonic() - tick_start >= self.tick_interval
+                self.id in _congested_channels
+                and self.in_msg_queue.qsize() <= _LOW_WATERMARK
             ):
-                self.logger.warning(
-                    "spent too long handling messages; %d deferred to next tick",
-                    self.in_msg_queue.qsize(),
-                )
-                break
+                _congested_channels.discard(self.id)
+                _signal_drain()
 
     def _tick_connections(self) -> None:
         """Prune closed subscribers; stash recoverable subs; handle owner
@@ -491,6 +556,10 @@ def remove_channel(ch: Channel) -> None:
     if ch._tick_task is not None:
         ch._tick_task.cancel()
         ch._tick_task = None
+    # A removed channel can never drain: lift its backpressure now or the
+    # reactors that fed it would wait forever.
+    _congested_channels.discard(ch.id)
+    _signal_drain()
     _all_channels.pop(ch.id, None)
     metrics.channel_num.labels(channel_type=ch.channel_type.name).dec()
     events.channel_removed.broadcast(ch.id)

@@ -54,6 +54,12 @@ class RudpSession:
         self.on_stream: Optional[Callable[[bytes], None]] = None
         self.on_close: Optional[Callable[[], None]] = None
         self.closed = False
+        self._dropped_unacked = False
+
+    def drop_unacked(self) -> None:
+        """Called by a consumer from inside on_stream to refuse the segment
+        (backpressure): it stays un-acked and is retried by the peer."""
+        self._dropped_unacked = True
 
     # -- sending ----------------------------------------------------------
 
@@ -94,17 +100,25 @@ class RudpSession:
             return
         deliver: list[bytes] = []
         with self._lock:
+            self._dropped_unacked = False
             if seq >= self._expected:
                 self._reorder[seq] = payload
                 while self._expected in self._reorder:
-                    deliver.append(self._reorder.pop(self._expected))
+                    nxt = self._reorder.pop(self._expected)
+                    if self.on_stream is not None:
+                        self.on_stream(nxt)
+                        if self._dropped_unacked:
+                            # Consumer refused the segment (backpressure):
+                            # put it back and stop advancing; the un-acked
+                            # window stalls the sender until we drain.
+                            self._reorder[self._expected] = nxt
+                            break
+                    else:
+                        deliver.append(nxt)
                     self._expected += 1
             # Ack what we have (cumulative), also re-acks duplicates.
             ack_dgram = _HEADER.pack(self.conv, CMD_ACK, 0, self._expected)
         self._send_datagram(ack_dgram)
-        if self.on_stream is not None:
-            for seg in deliver:
-                self.on_stream(seg)
 
     def fin(self) -> None:
         self.closed = True

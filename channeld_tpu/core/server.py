@@ -105,6 +105,8 @@ async def start_listening(conn_type: ConnectionType, network: str, addr: str):
             except ConnectionRefusedError:
                 await ws.close()
                 return
+            from .channel import congestion_wait, connection_congested
+
             try:
                 async for message in ws:
                     if isinstance(message, str):
@@ -112,6 +114,8 @@ async def start_listening(conn_type: ConnectionType, network: str, addr: str):
                     conn.on_bytes(message)
                     if conn.is_closing():
                         break
+                    if connection_congested(conn):
+                        await congestion_wait(conn)
             except websockets.ConnectionClosed:
                 pass
             finally:
@@ -143,7 +147,20 @@ async def start_listening(conn_type: ConnectionType, network: str, addr: str):
             except ConnectionRefusedError:
                 session.fin()
                 return
-            session.on_stream = conn.on_bytes
+
+            from .channel import connection_congested
+
+            def on_stream(seg: bytes) -> None:
+                # ARQ backpressure: while this connection's channels are
+                # congested, drop the segment *before* it is acked — the
+                # peer retransmits, so nothing is lost and its send window
+                # stalls, the reliable-UDP analog of pausing a TCP read.
+                if connection_congested(conn):
+                    session.drop_unacked()
+                    return
+                conn.on_bytes(seg)
+
+            session.on_stream = on_stream
             # FIN / peer loss must close the gateway connection like the
             # TCP/WS reactors do (recovery depends on this close event).
             session.on_close = lambda: conn.close(unexpected=True)
@@ -159,12 +176,20 @@ async def start_listening(conn_type: ConnectionType, network: str, addr: str):
 
 async def _reactor(conn: Connection, reader: asyncio.StreamReader) -> None:
     """Per-connection receive loop (ref: the per-conn recv goroutine)."""
+    from .channel import congestion_wait, connection_congested
+
     try:
         while not conn.is_closing():
             data = await reader.read(65536)
             if not data:
                 break
             conn.on_bytes(data)
+            if connection_congested(conn):
+                # A channel this connection fed is above its high
+                # watermark: stop reading from *this* socket until it
+                # drains — TCP backpressure, like the reference's blocking
+                # queue send (channel.go:295-310).
+                await congestion_wait(conn)
     except (ConnectionResetError, asyncio.IncompleteReadError, OSError):
         pass
     finally:

@@ -1,0 +1,223 @@
+"""ACL matrix, broadcast types, and sub/unsub notification semantics.
+
+(ref: pkg/channeld/channel_acl_test.go TestCheckACL:114 — the level ×
+role matrix; channel.go:495-520 Broadcast bit-filters;
+message.go:488-606 sub/unsub notification fan-out.)
+"""
+
+import pytest
+
+from channeld_tpu.core.acl import ChannelAccessType, check_acl
+from channeld_tpu.core.channel import create_channel, get_global_channel
+from channeld_tpu.core.message import (
+    MessageContext,
+    handle_server_to_client_user_message,
+    handle_sub_to_channel,
+    handle_unsub_from_channel,
+)
+from channeld_tpu.core.settings import ACLSettings, global_settings
+from channeld_tpu.core.subscription import subscribe_to_channel
+from channeld_tpu.core.types import (
+    BroadcastType,
+    ChannelAccessLevel,
+    ChannelType,
+    ConnectionType,
+    MessageType,
+)
+from channeld_tpu.protocol import control_pb2, wire_pb2
+
+from helpers import StubConnection, fresh_runtime
+
+
+@pytest.fixture(autouse=True)
+def runtime():
+    yield fresh_runtime()
+
+
+def set_acl(level: ChannelAccessLevel):
+    st = global_settings.channel_settings[ChannelType.GLOBAL]
+    global_settings.channel_settings[ChannelType.TEST] = type(st)(
+        acl=ACLSettings(sub=level, unsub=level, remove=level)
+    )
+
+
+def test_acl_matrix():
+    """Every level × caller-role combination (ref: TestCheckACL)."""
+    owner = StubConnection(1, ConnectionType.SERVER)
+    global_owner = StubConnection(2, ConnectionType.SERVER)
+    other = StubConnection(3, ConnectionType.CLIENT)
+    gch = get_global_channel()
+    gch.set_owner(global_owner)
+
+    for level, expect in [
+        (ChannelAccessLevel.NONE,
+         {"owner": False, "global": False, "other": False}),
+        (ChannelAccessLevel.OWNER_ONLY,
+         {"owner": True, "global": False, "other": False}),
+        (ChannelAccessLevel.OWNER_AND_GLOBAL_OWNER,
+         {"owner": True, "global": True, "other": False}),
+        (ChannelAccessLevel.ANY,
+         {"owner": True, "global": True, "other": True}),
+    ]:
+        set_acl(level)
+        ch = create_channel(ChannelType.TEST, owner)
+        for conn, key in [(owner, "owner"), (global_owner, "global"),
+                          (other, "other")]:
+            for op in (ChannelAccessType.SUB, ChannelAccessType.UNSUB,
+                       ChannelAccessType.REMOVE):
+                has, _ = check_acl(ch, conn, op)
+                assert has == expect[key], (level, key, op)
+        # Internal operations (no connection) always pass.
+        assert check_acl(ch, None, ChannelAccessType.REMOVE)[0] is True
+        from channeld_tpu.core.channel import remove_channel
+
+        remove_channel(ch)
+
+
+def make_channel_with_subs():
+    owner = StubConnection(1, ConnectionType.SERVER)
+    server = StubConnection(2, ConnectionType.SERVER)
+    client_a = StubConnection(3, ConnectionType.CLIENT)
+    client_b = StubConnection(4, ConnectionType.CLIENT)
+    ch = create_channel(ChannelType.SUBWORLD, owner)
+    for conn in (owner, server, client_a, client_b):
+        subscribe_to_channel(conn, ch, None)
+    return ch, owner, server, client_a, client_b
+
+
+def recipients(conns, msg_type=100):
+    return {
+        c.id for c in conns
+        if any(ctx.msg_type == msg_type for ctx in c.sent)
+    }
+
+
+def test_broadcast_bit_filters():
+    """(ref: channel.go:495-520)."""
+    ch, owner, server, client_a, client_b = make_channel_with_subs()
+    everyone = [owner, server, client_a, client_b]
+
+    cases = [
+        (BroadcastType.ALL, {1, 2, 3, 4}),
+        (BroadcastType.ALL_BUT_SENDER, {1, 2, 4}),  # sender = client_a (3)
+        (BroadcastType.ALL_BUT_OWNER, {2, 3, 4}),
+        (BroadcastType.ALL_BUT_CLIENT, {1, 2}),
+        (BroadcastType.ALL_BUT_SERVER, {3, 4}),
+        (BroadcastType.ALL_BUT_SENDER | BroadcastType.ALL_BUT_OWNER, {2, 4}),
+    ]
+    for bc, expected in cases:
+        for c in everyone:
+            c.sent.clear()
+        ch.broadcast(
+            MessageContext(
+                msg_type=100,
+                msg=wire_pb2.ServerForwardMessage(payload=b"x"),
+                broadcast=bc,
+                connection=client_a,
+                channel=ch,
+                channel_id=ch.id,
+            )
+        )
+        assert recipients(everyone) == expected, bc
+
+
+def test_server_forward_broadcast_and_single_connection():
+    """(ref: message.go HandleServerToClientUserMessage)."""
+    ch, owner, server, client_a, client_b = make_channel_with_subs()
+    everyone = [owner, server, client_a, client_b]
+
+    # NO_BROADCAST -> forwarded to the owner only.
+    ctx = MessageContext(
+        msg_type=101,
+        msg=wire_pb2.ServerForwardMessage(clientConnId=0, payload=b"x"),
+        broadcast=BroadcastType.NO_BROADCAST,
+        connection=server,
+        channel=ch,
+        channel_id=ch.id,
+    )
+    handle_server_to_client_user_message(ctx)
+    assert recipients(everyone, 101) == {owner.id}
+
+    # SINGLE_CONNECTION with a client id -> that client only. The target
+    # must be resolvable via the connection registry, so register a real
+    # Connection there.
+    from channeld_tpu.core import connection as connection_mod
+    from helpers import FakeTransport
+
+    global_settings.development = True
+    real_client = connection_mod.add_connection(FakeTransport(), ConnectionType.CLIENT)
+    real_client.state = 1
+    subscribe_to_channel(real_client, ch, None)
+    ctx2 = MessageContext(
+        msg_type=102,
+        msg=wire_pb2.ServerForwardMessage(clientConnId=real_client.id, payload=b"y"),
+        broadcast=BroadcastType.SINGLE_CONNECTION,
+        connection=server,
+        channel=ch,
+        channel_id=ch.id,
+    )
+    handle_server_to_client_user_message(ctx2)
+    real_client.flush()
+    from channeld_tpu.protocol import FrameDecoder
+
+    dec = FrameDecoder()
+    got = [
+        m.msgType
+        for chunk in real_client.transport.written
+        for p in dec.decode_packets(chunk)
+        for m in p.messages
+    ]
+    assert 102 in got
+    assert recipients(everyone, 102) == set()
+
+
+def test_sub_notifications_to_sender_target_owner():
+    """(ref: message.go:488-545): sender, target and owner each notified."""
+    ch, owner, server, client_a, client_b = make_channel_with_subs()
+    from channeld_tpu.core import connection as connection_mod
+    from helpers import FakeTransport
+
+    global_settings.development = True
+    new_client = connection_mod.add_connection(FakeTransport(), ConnectionType.CLIENT)
+    new_client.state = 1
+
+    for c in (owner, server):
+        c.sent.clear()
+    # The server subscribes the new client (server has ANY access on
+    # SUBWORLD per default hifi-style settings -> use GLOBAL defaults).
+    ctx = MessageContext(
+        msg_type=MessageType.SUB_TO_CHANNEL,
+        msg=control_pb2.SubscribedToChannelMessage(connId=new_client.id),
+        connection=server,
+        channel=ch,
+        channel_id=ch.id,
+        stub_id=9,
+    )
+    # Owner-only ACL would deny the server; open it up.
+    global_settings.channel_settings[ChannelType.SUBWORLD] = type(
+        global_settings.channel_settings[ChannelType.GLOBAL]
+    )(acl=ACLSettings(sub=3, unsub=3, remove=3))
+    handle_sub_to_channel(ctx)
+
+    assert new_client in ch.subscribed_connections
+    # Sender got the stubbed result.
+    sender_msgs = [c for c in server.sent if c.msg_type == MessageType.SUB_TO_CHANNEL]
+    assert sender_msgs and sender_msgs[0].stub_id == 9
+    # Owner notified too.
+    assert any(c.msg_type == MessageType.SUB_TO_CHANNEL for c in owner.sent)
+
+    # Unsub: sender + target + owner notified; owner unsubbing itself
+    # clears ownership.
+    for c in (owner, server):
+        c.sent.clear()
+    ctx = MessageContext(
+        msg_type=MessageType.UNSUB_FROM_CHANNEL,
+        msg=control_pb2.UnsubscribedFromChannelMessage(connId=new_client.id),
+        connection=server,
+        channel=ch,
+        channel_id=ch.id,
+    )
+    handle_unsub_from_channel(ctx)
+    assert new_client not in ch.subscribed_connections
+    assert any(c.msg_type == MessageType.UNSUB_FROM_CHANNEL for c in server.sent)
+    assert any(c.msg_type == MessageType.UNSUB_FROM_CHANNEL for c in owner.sent)
