@@ -111,6 +111,7 @@ class Channel:
         self.recoverable_subs: dict = {}  # pit -> RecoverableSubscription
         self.logger = get_logger(f"channel.{self.channel_type.name}.{channel_id}")
         self._tick_task: Optional[asyncio.Task] = None
+        self._wake = asyncio.Event()
         self.state = ChannelState.OPEN if self.has_owner() else ChannelState.INIT
 
     # ---- identity / time -------------------------------------------------
@@ -229,6 +230,7 @@ class Channel:
             # is the last resort (the reference would block forever).
             self.logger.warning("in-queue full, dropping message")
             return
+        self._wake.set()
         if self.in_msg_queue.qsize() >= _HIGH_WATERMARK:
             _congested_channels.add(self.id)
             # Remember which connection fed the congested queue so only its
@@ -254,6 +256,26 @@ class Channel:
         if exc is not None:
             self.logger.error("channel tick task died: %r", exc)
 
+    def wake(self) -> None:
+        """Wake a parked tick loop (new message, subscription, ...)."""
+        self._wake.set()
+
+    def _may_park(self) -> bool:
+        if (
+            self.subscribed_connections
+            or self.recoverable_subs
+            or not self.in_msg_queue.empty()
+        ):
+            return False
+        if self.channel_type == ChannelType.GLOBAL:
+            # The GLOBAL tick drives the spatial controller (handover
+            # detection, server reaping): never park while one exists.
+            from ..spatial.controller import get_spatial_controller
+
+            if get_spatial_controller() is not None:
+                return False
+        return True
+
     async def _tick_loop(self) -> None:
         while not self.is_removing():
             tick_start = time.monotonic()
@@ -262,7 +284,24 @@ class Channel:
             metrics.channel_tick_duration.labels(
                 channel_type=self.channel_type.name
             ).observe(elapsed)
-            await asyncio.sleep(max(self.tick_interval - elapsed, 0))
+            if not self._may_park():
+                await asyncio.sleep(max(self.tick_interval - elapsed, 0))
+            else:
+                # Idle channel: park until a message/subscription arrives
+                # (or a coarse heartbeat) instead of spinning at the tick
+                # cadence — 10K mostly-idle channels would otherwise wake
+                # 500K times per second.
+                self._wake.clear()
+                if self.in_msg_queue.empty() and self._may_park():
+                    try:
+                        await asyncio.wait_for(self._wake.wait(), timeout=0.5)
+                    except asyncio.TimeoutError:
+                        pass
+                # Pace even after a wake so a message stream to an idle
+                # channel can't drive ticks above 1/tick_interval.
+                await asyncio.sleep(
+                    max(self.tick_interval - (time.monotonic() - tick_start), 0)
+                )
 
     def tick_once(self, now: Optional[int] = None, tick_start: Optional[float] = None) -> None:
         """One synchronous tick; ``now`` is channel time, injectable for

@@ -79,6 +79,11 @@ def subscribe_to_channel(
         ch.data.max_fanout_interval_ms = merged.fanOutIntervalMs
 
     ch.subscribed_connections[conn] = cs
+    # A parked channel must start fanning out to its new subscriber now,
+    # not at the next heartbeat.
+    wake = getattr(ch, "wake", None)
+    if callable(wake):
+        wake()
 
     if ch.channel_type == ChannelType.SPATIAL:
         conn.spatial_subscriptions[ch.id] = cs.options
