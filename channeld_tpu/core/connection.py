@@ -15,6 +15,11 @@ import time
 from typing import Callable, Optional, Protocol
 
 from ..protocol import FramingError, MESSAGE_TEMPLATES, encode_frame, wire_pb2
+
+try:
+    from ..native import codec as _native_codec
+except ImportError:
+    _native_codec = None
 from ..protocol.framing import FrameDecoder, HEADER_SIZE, MAX_PACKET_SIZE
 from ..protocol import snappy as snappy_codec
 from ..utils.idalloc import hash_string
@@ -48,26 +53,43 @@ class MessageSender(Protocol):
     def send(self, conn: "Connection", ctx) -> None: ...
 
 
+def _varint_size(v: int) -> int:
+    n = 1
+    while v >= 0x80:
+        v >>= 7
+        n += 1
+    return n
+
+
+def _pack_size(ctx, body_len: int) -> int:
+    """Exact encoded size of one MessagePack entry (proto3 zero-omission)."""
+    size = 0
+    for v in (ctx.channel_id, ctx.broadcast, ctx.stub_id, ctx.msg_type):
+        if v:
+            size += 1 + _varint_size(int(v))
+    if body_len:
+        size += 1 + _varint_size(body_len) + body_len
+    return 1 + _varint_size(size) + size
+
+
 class QueuedMessagePackSender:
     """Marshal into the send queue; flushed by the connection's pump
-    (ref: connection.go:54-84)."""
+    (ref: connection.go:54-84). Queue entries are light tuples
+    (channelId, broadcast, stubId, msgType, body) so the native packet
+    encoder consumes them without protobuf object churn."""
 
     def send(self, conn: "Connection", ctx) -> None:
         body = ctx.msg.SerializeToString()
-        mp = wire_pb2.MessagePack(
-            channelId=ctx.channel_id,
-            broadcast=ctx.broadcast,
-            stubId=ctx.stub_id,
-            msgType=ctx.msg_type,
-            msgBody=body,
-        )
-        if mp.ByteSize() >= MAX_PACKET_SIZE - HEADER_SIZE:
+        if _pack_size(ctx, len(body)) >= MAX_PACKET_SIZE - HEADER_SIZE:
             conn.logger.warning(
-                "message dropped: size %d exceeds packet limit", mp.ByteSize()
+                "message dropped: size %d exceeds packet limit", len(body)
             )
             return
         if not conn.is_closing():
-            conn.send_queue.append(mp)
+            conn.send_queue.append(
+                (int(ctx.channel_id), int(ctx.broadcast), int(ctx.stub_id),
+                 int(ctx.msg_type), body)
+            )
 
 
 class Connection:
@@ -84,8 +106,8 @@ class Connection:
         self.transport = transport
         self.decoder = FrameDecoder()
         self.sender: MessageSender = QueuedMessagePackSender()
-        self.send_queue: list[wire_pb2.MessagePack] = []
-        self.oversized_msg_pack: Optional[wire_pb2.MessagePack] = None
+        # (channelId, broadcast, stubId, msgType, body) tuples.
+        self.send_queue: list[tuple] = []
         self.pit = ""
         self.fsm = fsm
         self.fsm_disallowed_counter = 0
@@ -204,48 +226,67 @@ class Connection:
         self.sender.send(self, ctx)
 
     def flush(self) -> None:
-        """Batch queued messages into one packet (<= 64KB with oversize
-        carry-over), compress, frame, write (ref: connection.go:626-714)."""
-        if not self.send_queue and self.oversized_msg_pack is None:
+        """Batch queued messages into <=64KB packets, compress, frame,
+        write (ref: connection.go:626-714). The native codec builds the
+        protobuf wire bytes directly from the queued tuples."""
+        if not self.send_queue:
             return
-        p = wire_pb2.Packet()
-        if self.oversized_msg_pack is not None:
-            p.messages.append(self.oversized_msg_pack)
-            self.oversized_msg_pack = None
-        size = p.ByteSize()
-        while self.send_queue:
-            mp = self.send_queue.pop(0)
-            # Field tag + length prefix costs a few bytes beyond the body.
-            size += mp.ByteSize() + 6
-            if p.messages and size > MAX_PACKET_SIZE:
-                self.oversized_msg_pack = mp
-                break
-            p.messages.append(mp)
-            metrics.msg_sent.labels(
-                conn_type=self.connection_type.name,
-                channel_type="",
-                msg_type=str(mp.msgType),
-            ).inc()
-        if not p.messages:
-            return
-        if len(p.messages) > 1:
-            metrics.packet_combined.labels(conn_type=self.connection_type.name).inc()
-        body = p.SerializeToString()
+        batch, self.send_queue = self.send_queue, []
         ct = self.compression_type
         if ct == CompressionType.SNAPPY and not snappy_codec.available():
             ct = CompressionType.NO_COMPRESSION
+
+        # Any encode failure must stay contained to this connection: the
+        # shared flush pump calls flush() for every connection in turn.
         try:
-            frame = encode_frame(body, int(ct))
-        except FramingError as e:
-            self.logger.error("packet oversized at flush: %s", e)
-            return
-        try:
-            self.transport.write(frame)
+            if _native_codec is not None:
+                frames = _native_codec.encode_packets(batch, int(ct))
+            else:
+                frames = self._encode_packets_py(batch, int(ct))
         except Exception as e:
-            self.logger.error("error writing packet: %s", e)
+            self.logger.error("packet encode failed, dropping batch: %s", e)
             return
-        metrics.packet_sent.labels(conn_type=self.connection_type.name).inc()
-        metrics.bytes_sent.labels(conn_type=self.connection_type.name).inc(len(frame))
+
+        ct_name = self.connection_type.name
+        sent_frames = 0
+        for frame in frames:
+            try:
+                self.transport.write(frame)
+            except Exception as e:
+                self.logger.error("error writing packet: %s", e)
+                break
+            sent_frames += 1
+            metrics.packet_sent.labels(conn_type=ct_name).inc()
+            metrics.bytes_sent.labels(conn_type=ct_name).inc(len(frame))
+        if sent_frames and sent_frames < len(batch):
+            metrics.packet_combined.labels(conn_type=ct_name).inc()
+        if sent_frames == len(frames):
+            for _, _, _, msg_type, _ in batch:
+                metrics.msg_sent.labels(
+                    conn_type=ct_name, channel_type="", msg_type=str(msg_type),
+                ).inc()
+
+    def _encode_packets_py(self, batch: list[tuple], ct: int) -> list[bytes]:
+        """Pure-Python fallback for the native packet builder."""
+        frames: list[bytes] = []
+        p = wire_pb2.Packet()
+        size = 0
+        for channel_id, broadcast, stub_id, msg_type, body in batch:
+            entry = len(body) + 32
+            if entry > MAX_PACKET_SIZE:
+                continue
+            if p.messages and size + entry > MAX_PACKET_SIZE:
+                frames.append(encode_frame(p.SerializeToString(), ct))
+                p = wire_pb2.Packet()
+                size = 0
+            p.messages.add(
+                channelId=channel_id, broadcast=broadcast, stubId=stub_id,
+                msgType=msg_type, msgBody=body,
+            )
+            size += entry
+        if p.messages:
+            frames.append(encode_frame(p.SerializeToString(), ct))
+        return frames
 
     # ---- lifecycle -------------------------------------------------------
 

@@ -202,7 +202,7 @@ async def flush_loop(interval: float = 0.001) -> None:
     (ref: the per-conn 1ms flush goroutine, connection.go:180-184)."""
     while True:
         for conn in list(all_connections().values()):
-            if not conn.is_closing() and (conn.send_queue or conn.oversized_msg_pack):
+            if not conn.is_closing() and conn.send_queue:
                 conn.flush()
         await asyncio.sleep(interval)
 

@@ -17,6 +17,7 @@
 
 #include <cstdint>
 #include <cstring>
+#include <string>
 
 extern "C" {
 // snappy-c.h stable ABI (status: 0 = OK, 1 = INVALID_INPUT, 2 = BUFFER_TOO_SMALL)
@@ -166,6 +167,131 @@ static PyObject* codec_decode_frames(PyObject* self, PyObject* args) {
   return Py_BuildValue("(Nn)", frames, (Py_ssize_t)pos);
 }
 
+// ---- outbound packet building -------------------------------------------
+//
+// Hand-rolled protobuf wire encoding of chtpu.Packet:
+//   Packet.messages    = field 1, length-delimited (tag 0x0A)
+//   MessagePack fields = channelId(1)/broadcast(2)/stubId(3)/msgType(4)
+//                        varint, msgBody(5) bytes; proto3 zero-omission.
+// Byte-identical to the generated serializer (verified in tests).
+
+static size_t varint_size(uint64_t v) {
+  size_t n = 1;
+  while (v >= 0x80) {
+    v >>= 7;
+    n++;
+  }
+  return n;
+}
+
+static void write_varint(std::string& out, uint64_t v) {
+  while (v >= 0x80) {
+    out.push_back((char)((v & 0x7F) | 0x80));
+    v >>= 7;
+  }
+  out.push_back((char)v);
+}
+
+// encode_packets(msgs, compression) -> list[bytes]
+//
+// msgs: sequence of (channelId, broadcast, stubId, msgType, msgBody).
+// Batches message packs into framed packets, each body <= 64KB before
+// compression (mirroring Connection.flush's batching + oversize skip);
+// returns the ready-to-write frames.
+static PyObject* codec_encode_packets(PyObject* self, PyObject* args) {
+  PyObject* seq;
+  int compression = 0;
+  if (!PyArg_ParseTuple(args, "O|i", &seq, &compression)) return nullptr;
+  PyObject* fast = PySequence_Fast(seq, "encode_packets expects a sequence");
+  if (!fast) return nullptr;
+
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+  PyObject* frames = PyList_New(0);
+  if (!frames) {
+    Py_DECREF(fast);
+    return nullptr;
+  }
+
+  std::string body;
+  body.reserve(MAX_PACKET_SIZE + 64);
+
+  auto flush_body = [&](void) -> bool {
+    if (body.empty()) return true;
+    PyObject* frame_args = Py_BuildValue("(y#i)", body.data(),
+                                         (Py_ssize_t)body.size(), compression);
+    if (!frame_args) return false;
+    PyObject* frame = codec_encode_frame(nullptr, frame_args);
+    Py_DECREF(frame_args);
+    if (!frame) return false;
+    int rc = PyList_Append(frames, frame);
+    Py_DECREF(frame);
+    body.clear();
+    return rc == 0;
+  };
+
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject* item = PySequence_Fast_GET_ITEM(fast, i);
+    unsigned long ch, bc, stub, mt;
+    Py_buffer mb;
+    if (!PyArg_ParseTuple(item, "kkkky*", &ch, &bc, &stub, &mt, &mb)) {
+      Py_DECREF(fast);
+      Py_DECREF(frames);
+      return nullptr;
+    }
+    // MessagePack submessage payload size.
+    size_t pack_size = 0;
+    if (ch) pack_size += 1 + varint_size(ch);
+    if (bc) pack_size += 1 + varint_size(bc);
+    if (stub) pack_size += 1 + varint_size(stub);
+    if (mt) pack_size += 1 + varint_size(mt);
+    if (mb.len) pack_size += 1 + varint_size((uint64_t)mb.len) + (size_t)mb.len;
+    size_t entry_size = 1 + varint_size(pack_size) + pack_size;
+
+    if (entry_size > MAX_PACKET_SIZE) {
+      PyBuffer_Release(&mb);
+      continue;  // oversized single message: skip (caller logged already)
+    }
+    if (body.size() + entry_size > MAX_PACKET_SIZE) {
+      if (!flush_body()) {
+        PyBuffer_Release(&mb);
+        Py_DECREF(fast);
+        Py_DECREF(frames);
+        return nullptr;
+      }
+    }
+    body.push_back((char)0x0A);  // Packet.messages tag
+    write_varint(body, pack_size);
+    if (ch) {
+      body.push_back((char)0x08);
+      write_varint(body, ch);
+    }
+    if (bc) {
+      body.push_back((char)0x10);
+      write_varint(body, bc);
+    }
+    if (stub) {
+      body.push_back((char)0x18);
+      write_varint(body, stub);
+    }
+    if (mt) {
+      body.push_back((char)0x20);
+      write_varint(body, mt);
+    }
+    if (mb.len) {
+      body.push_back((char)0x2A);
+      write_varint(body, (uint64_t)mb.len);
+      body.append(static_cast<const char*>(mb.buf), (size_t)mb.len);
+    }
+    PyBuffer_Release(&mb);
+  }
+  Py_DECREF(fast);
+  if (!flush_body()) {
+    Py_DECREF(frames);
+    return nullptr;
+  }
+  return frames;
+}
+
 // compress(data: bytes) -> bytes ; uncompress(data: bytes) -> bytes
 static PyObject* codec_compress(PyObject* self, PyObject* args) {
   Py_buffer in;
@@ -220,6 +346,8 @@ static PyMethodDef codec_methods[] = {
      "encode_frame(body, compression=0) -> framed bytes"},
     {"decode_frames", codec_decode_frames, METH_VARARGS,
      "decode_frames(buf) -> ([(body, compression)], consumed)"},
+    {"encode_packets", codec_encode_packets, METH_VARARGS,
+     "encode_packets([(chId, bc, stub, mt, body)], compression) -> [frames]"},
     {"compress", codec_compress, METH_VARARGS, "snappy compress"},
     {"uncompress", codec_uncompress, METH_VARARGS, "snappy uncompress"},
     {nullptr, nullptr, 0, nullptr},
