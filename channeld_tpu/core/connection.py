@@ -61,15 +61,21 @@ def _varint_size(v: int) -> int:
     return n
 
 
-def _pack_size(ctx, body_len: int) -> int:
+def _entry_size(channel_id: int, broadcast: int, stub_id: int, msg_type: int,
+                body_len: int) -> int:
     """Exact encoded size of one MessagePack entry (proto3 zero-omission)."""
     size = 0
-    for v in (ctx.channel_id, ctx.broadcast, ctx.stub_id, ctx.msg_type):
+    for v in (channel_id, broadcast, stub_id, msg_type):
         if v:
             size += 1 + _varint_size(int(v))
     if body_len:
         size += 1 + _varint_size(body_len) + body_len
     return 1 + _varint_size(size) + size
+
+
+def _pack_size(ctx, body_len: int) -> int:
+    return _entry_size(ctx.channel_id, ctx.broadcast, ctx.stub_id,
+                       ctx.msg_type, body_len)
 
 
 class QueuedMessagePackSender:
@@ -248,20 +254,31 @@ class Connection:
             return
 
         ct_name = self.connection_type.name
-        sent_frames = 0
-        for frame in frames:
+        # Messages per frame, re-derived with the same exact size walk the
+        # encoders use, so partial writes account only delivered messages.
+        per_frame: list[list] = [[]]
+        size = 0
+        for entry in batch:
+            esize = _entry_size(entry[0], entry[1], entry[2], entry[3], len(entry[4]))
+            if esize > MAX_PACKET_SIZE:
+                continue
+            if per_frame[-1] and size + esize > MAX_PACKET_SIZE:
+                per_frame.append([])
+                size = 0
+            per_frame[-1].append(entry)
+            size += esize
+        for i, frame in enumerate(frames):
             try:
                 self.transport.write(frame)
             except Exception as e:
                 self.logger.error("error writing packet: %s", e)
                 break
-            sent_frames += 1
             metrics.packet_sent.labels(conn_type=ct_name).inc()
             metrics.bytes_sent.labels(conn_type=ct_name).inc(len(frame))
-        if sent_frames and sent_frames < len(batch):
-            metrics.packet_combined.labels(conn_type=ct_name).inc()
-        if sent_frames == len(frames):
-            for _, _, _, msg_type, _ in batch:
+            delivered = per_frame[i] if i < len(per_frame) else []
+            if len(delivered) > 1:
+                metrics.packet_combined.labels(conn_type=ct_name).inc()
+            for _, _, _, msg_type, _ in delivered:
                 metrics.msg_sent.labels(
                     conn_type=ct_name, channel_type="", msg_type=str(msg_type),
                 ).inc()
@@ -272,8 +289,9 @@ class Connection:
         p = wire_pb2.Packet()
         size = 0
         for channel_id, broadcast, stub_id, msg_type, body in batch:
-            entry = len(body) + 32
+            entry = _entry_size(channel_id, broadcast, stub_id, msg_type, len(body))
             if entry > MAX_PACKET_SIZE:
+                logger.warning("skipping oversized message (%d bytes)", entry)
                 continue
             if p.messages and size + entry > MAX_PACKET_SIZE:
                 frames.append(encode_frame(p.SerializeToString(), ct))

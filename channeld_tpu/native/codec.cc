@@ -37,23 +37,14 @@ static const size_t MAX_PACKET_SIZE = 0xFFFF;
 
 static PyObject* CodecError;
 
-// encode_frame(body: bytes, compression: int = 0) -> bytes
-static PyObject* codec_encode_frame(PyObject* self, PyObject* args) {
-  Py_buffer body;
-  int compression = 0;
-  if (!PyArg_ParseTuple(args, "y*|i", &body, &compression)) return nullptr;
-
-  const char* payload = static_cast<const char*>(body.buf);
-  size_t payload_len = static_cast<size_t>(body.len);
+// Core frame construction shared by encode_frame and encode_packets.
+static PyObject* build_frame(const char* payload, size_t payload_len,
+                             int compression) {
   char* scratch = nullptr;
-
   if (compression == 1) {
     size_t max_len = snappy_max_compressed_length(payload_len);
     scratch = static_cast<char*>(PyMem_Malloc(max_len));
-    if (!scratch) {
-      PyBuffer_Release(&body);
-      return PyErr_NoMemory();
-    }
+    if (!scratch) return PyErr_NoMemory();
     size_t compressed_len = max_len;
     if (snappy_compress(payload, payload_len, scratch, &compressed_len) == 0 &&
         compressed_len < payload_len) {
@@ -67,7 +58,6 @@ static PyObject* codec_encode_frame(PyObject* self, PyObject* args) {
 
   if (payload_len > MAX_PACKET_SIZE) {
     if (scratch) PyMem_Free(scratch);
-    PyBuffer_Release(&body);
     PyErr_Format(CodecError, "packet oversized: %zu", payload_len);
     return nullptr;
   }
@@ -85,6 +75,16 @@ static PyObject* codec_encode_frame(PyObject* self, PyObject* args) {
     memcpy(dst + HEADER_SIZE, payload, payload_len);
   }
   if (scratch) PyMem_Free(scratch);
+  return out;
+}
+
+// encode_frame(body: bytes, compression: int = 0) -> bytes
+static PyObject* codec_encode_frame(PyObject* self, PyObject* args) {
+  Py_buffer body;
+  int compression = 0;
+  if (!PyArg_ParseTuple(args, "y*|i", &body, &compression)) return nullptr;
+  PyObject* out = build_frame(static_cast<const char*>(body.buf),
+                              (size_t)body.len, compression);
   PyBuffer_Release(&body);
   return out;
 }
@@ -217,11 +217,7 @@ static PyObject* codec_encode_packets(PyObject* self, PyObject* args) {
 
   auto flush_body = [&](void) -> bool {
     if (body.empty()) return true;
-    PyObject* frame_args = Py_BuildValue("(y#i)", body.data(),
-                                         (Py_ssize_t)body.size(), compression);
-    if (!frame_args) return false;
-    PyObject* frame = codec_encode_frame(nullptr, frame_args);
-    Py_DECREF(frame_args);
+    PyObject* frame = build_frame(body.data(), body.size(), compression);
     if (!frame) return false;
     int rc = PyList_Append(frames, frame);
     Py_DECREF(frame);

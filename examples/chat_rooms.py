@@ -7,6 +7,8 @@ receive fan-outs on their own cadence.
 
 Run:    python examples/chat_rooms.py [-ca :12108] [-cn ws]
 Client: python examples/sim_clients.py --behavior chat
+Web UI: run with -cn ws, then open http://localhost:8000 (the example
+serves examples/web/ over aiohttp, like the reference's web demo).
 """
 
 import asyncio
@@ -54,6 +56,21 @@ async def main(argv) -> None:
         asyncio.ensure_future(flush_loop()),
         asyncio.ensure_future(unauth_reaper_loop()),
     ]
+
+    # Serve the browser client when running the WebSocket transport.
+    if global_settings.client_network in ("ws", "websocket"):
+        from aiohttp import web
+
+        app = web.Application()
+        web_dir = os.path.join(os.path.dirname(__file__), "web")
+        app.router.add_get(
+            "/", lambda r: web.FileResponse(os.path.join(web_dir, "index.html"))
+        )
+        app.router.add_static("/", web_dir)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        await web.TCPSite(runner, "0.0.0.0", 8000).start()
+        print("web UI on http://localhost:8000", flush=True)
     await start_listening(
         ConnectionType.SERVER,
         global_settings.server_network,
