@@ -135,6 +135,14 @@ class Client:
             MessageType.RECOVERY_CHANNEL_DATA, control_pb2.ChannelDataRecoveryMessage
         )
         self.set_message_entry(MessageType.RECOVERY_END, control_pb2.EndRecoveryMessage)
+        self.set_message_entry(
+            MessageType.CHANNEL_OWNER_LOST, control_pb2.ChannelOwnerLostMessage
+        )
+        self.set_message_entry(
+            MessageType.CHANNEL_OWNER_RECOVERED,
+            control_pb2.ChannelOwnerRecoveredMessage,
+        )
+
 
     # ---- registry ----------------------------------------------------------
 

@@ -246,28 +246,15 @@ class Connection:
         # shared flush pump calls flush() for every connection in turn.
         try:
             if _native_codec is not None:
-                frames = _native_codec.encode_packets(batch, int(ct))
+                frames, counts = _native_codec.encode_packets(batch, int(ct))
             else:
-                frames = self._encode_packets_py(batch, int(ct))
+                frames, counts = self._encode_packets_py(batch, int(ct))
         except Exception as e:
             self.logger.error("packet encode failed, dropping batch: %s", e)
             return
 
         ct_name = self.connection_type.name
-        # Messages per frame, re-derived with the same exact size walk the
-        # encoders use, so partial writes account only delivered messages.
-        per_frame: list[list] = [[]]
-        size = 0
-        for entry in batch:
-            esize = _entry_size(entry[0], entry[1], entry[2], entry[3], len(entry[4]))
-            if esize > MAX_PACKET_SIZE:
-                continue
-            if per_frame[-1] and size + esize > MAX_PACKET_SIZE:
-                per_frame.append([])
-                size = 0
-            per_frame[-1].append(entry)
-            size += esize
-        for i, frame in enumerate(frames):
+        for frame, count in zip(frames, counts):
             try:
                 self.transport.write(frame)
             except Exception as e:
@@ -275,26 +262,27 @@ class Connection:
                 break
             metrics.packet_sent.labels(conn_type=ct_name).inc()
             metrics.bytes_sent.labels(conn_type=ct_name).inc(len(frame))
-            delivered = per_frame[i] if i < len(per_frame) else []
-            if len(delivered) > 1:
+            if count > 1:
                 metrics.packet_combined.labels(conn_type=ct_name).inc()
-            for _, _, _, msg_type, _ in delivered:
-                metrics.msg_sent.labels(
-                    conn_type=ct_name, channel_type="", msg_type=str(msg_type),
-                ).inc()
+            metrics.msg_sent.labels(
+                conn_type=ct_name, channel_type="", msg_type="",
+            ).inc(count)
 
-    def _encode_packets_py(self, batch: list[tuple], ct: int) -> list[bytes]:
-        """Pure-Python fallback for the native packet builder."""
+    def _encode_packets_py(self, batch: list[tuple], ct: int):
+        """Pure-Python fallback for the native packet builder; returns
+        (frames, per-frame message counts)."""
         frames: list[bytes] = []
+        counts: list[int] = []
         p = wire_pb2.Packet()
         size = 0
         for channel_id, broadcast, stub_id, msg_type, body in batch:
             entry = _entry_size(channel_id, broadcast, stub_id, msg_type, len(body))
             if entry > MAX_PACKET_SIZE:
-                logger.warning("skipping oversized message (%d bytes)", entry)
+                self.logger.warning("skipping oversized message (%d bytes)", entry)
                 continue
             if p.messages and size + entry > MAX_PACKET_SIZE:
                 frames.append(encode_frame(p.SerializeToString(), ct))
+                counts.append(len(p.messages))
                 p = wire_pb2.Packet()
                 size = 0
             p.messages.add(
@@ -304,7 +292,8 @@ class Connection:
             size += entry
         if p.messages:
             frames.append(encode_frame(p.SerializeToString(), ct))
-        return frames
+            counts.append(len(p.messages))
+        return frames, counts
 
     # ---- lifecycle -------------------------------------------------------
 

@@ -192,12 +192,13 @@ static void write_varint(std::string& out, uint64_t v) {
   out.push_back((char)v);
 }
 
-// encode_packets(msgs, compression) -> list[bytes]
+// encode_packets(msgs, compression) -> (list[bytes], list[int])
 //
 // msgs: sequence of (channelId, broadcast, stubId, msgType, msgBody).
 // Batches message packs into framed packets, each body <= 64KB before
 // compression (mirroring Connection.flush's batching + oversize skip);
-// returns the ready-to-write frames.
+// returns the ready-to-write frames plus the number of messages packed
+// into each frame (for exact sent-metrics attribution).
 static PyObject* codec_encode_packets(PyObject* self, PyObject* args) {
   PyObject* seq;
   int compression = 0;
@@ -212,8 +213,16 @@ static PyObject* codec_encode_packets(PyObject* self, PyObject* args) {
     return nullptr;
   }
 
+  PyObject* counts = PyList_New(0);
+  if (!counts) {
+    Py_DECREF(fast);
+    Py_DECREF(frames);
+    return nullptr;
+  }
+
   std::string body;
   body.reserve(MAX_PACKET_SIZE + 64);
+  long body_msgs = 0;
 
   auto flush_body = [&](void) -> bool {
     if (body.empty()) return true;
@@ -221,7 +230,13 @@ static PyObject* codec_encode_packets(PyObject* self, PyObject* args) {
     if (!frame) return false;
     int rc = PyList_Append(frames, frame);
     Py_DECREF(frame);
+    if (rc != 0) return false;
+    PyObject* cnt = PyLong_FromLong(body_msgs);
+    if (!cnt) return false;
+    rc = PyList_Append(counts, cnt);
+    Py_DECREF(cnt);
     body.clear();
+    body_msgs = 0;
     return rc == 0;
   };
 
@@ -232,6 +247,7 @@ static PyObject* codec_encode_packets(PyObject* self, PyObject* args) {
     if (!PyArg_ParseTuple(item, "kkkky*", &ch, &bc, &stub, &mt, &mb)) {
       Py_DECREF(fast);
       Py_DECREF(frames);
+      Py_DECREF(counts);
       return nullptr;
     }
     // MessagePack submessage payload size.
@@ -245,13 +261,14 @@ static PyObject* codec_encode_packets(PyObject* self, PyObject* args) {
 
     if (entry_size > MAX_PACKET_SIZE) {
       PyBuffer_Release(&mb);
-      continue;  // oversized single message: skip (caller logged already)
+      continue;  // oversized single message: skip (caller logs)
     }
     if (body.size() + entry_size > MAX_PACKET_SIZE) {
       if (!flush_body()) {
         PyBuffer_Release(&mb);
         Py_DECREF(fast);
         Py_DECREF(frames);
+        Py_DECREF(counts);
         return nullptr;
       }
     }
@@ -278,14 +295,16 @@ static PyObject* codec_encode_packets(PyObject* self, PyObject* args) {
       write_varint(body, (uint64_t)mb.len);
       body.append(static_cast<const char*>(mb.buf), (size_t)mb.len);
     }
+    body_msgs++;
     PyBuffer_Release(&mb);
   }
   Py_DECREF(fast);
   if (!flush_body()) {
     Py_DECREF(frames);
+    Py_DECREF(counts);
     return nullptr;
   }
-  return frames;
+  return Py_BuildValue("(NN)", frames, counts);
 }
 
 // compress(data: bytes) -> bytes ; uncompress(data: bytes) -> bytes
@@ -343,7 +362,7 @@ static PyMethodDef codec_methods[] = {
     {"decode_frames", codec_decode_frames, METH_VARARGS,
      "decode_frames(buf) -> ([(body, compression)], consumed)"},
     {"encode_packets", codec_encode_packets, METH_VARARGS,
-     "encode_packets([(chId, bc, stub, mt, body)], compression) -> [frames]"},
+     "encode_packets([(chId, bc, stub, mt, body)], compression) -> ([frames], [counts])"},
     {"compress", codec_compress, METH_VARARGS, "snappy compress"},
     {"uncompress", codec_uncompress, METH_VARARGS, "snappy uncompress"},
     {nullptr, nullptr, 0, nullptr},

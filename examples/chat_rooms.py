@@ -66,6 +66,10 @@ async def main(argv) -> None:
         app.router.add_get(
             "/", lambda r: web.FileResponse(os.path.join(web_dir, "index.html"))
         )
+        client_port = global_settings.client_address.rsplit(":", 1)[-1]
+        app.router.add_get(
+            "/ws-port", lambda r: web.Response(text=client_port)
+        )
         app.router.add_static("/", web_dir)
         runner = web.AppRunner(app)
         await runner.setup()

@@ -1,0 +1,102 @@
+"""Failure + recovery demo: a spatial server crashes and reclaims its
+world (ref: the §5 failure-detection/recovery subsystem).
+
+Run the gateway with recoverable servers first:
+
+    python -m channeld_tpu -dev -scr -scc config/spatial_static_2x2.json \
+        -imports channeld_tpu.models.sim
+
+then:  python examples/recovery_demo.py
+
+The demo: a master owns GLOBAL; spatial servers allocate the world; one
+server's socket is cut mid-session (simulated crash); a new connection
+re-authenticates with the same PIT, reclaims the old connection id, and
+receives ChannelDataRecoveryMessage for every channel it owned, then
+RECOVERY_END.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from channeld_tpu.client import Client
+from channeld_tpu.core.types import BroadcastType, MessageType
+from channeld_tpu.models import sim_pb2
+from channeld_tpu.protocol import control_pb2
+from channeld_tpu.utils.anyutil import pack_any
+
+
+def auth(client: Client, pit: str) -> None:
+    client.auth(pit=pit)
+    end = time.time() + 5
+    while client.id == 0 and time.time() < end:
+        client.tick(timeout=0.05)
+    assert client.id, f"{pit}: auth failed"
+
+
+def main() -> None:
+    addr = "127.0.0.1:11288"
+
+    master = Client(addr)
+    auth(master, "master")
+    master.send(0, BroadcastType.NO_BROADCAST, MessageType.CREATE_CHANNEL,
+                control_pb2.CreateChannelMessage(channelType=1))
+    master.tick(timeout=0.2)
+
+    # Four spatial servers allocate the 2x2 world.
+    servers = []
+    for i in range(4):
+        s = Client(addr)
+        auth(s, f"spatial{i}")
+        ready = [False]
+        s.add_message_handler(MessageType.SPATIAL_CHANNELS_READY,
+                              lambda c, ch, m, r=ready: r.__setitem__(0, True))
+        s.send(0, BroadcastType.NO_BROADCAST, MessageType.CREATE_SPATIAL_CHANNEL,
+               control_pb2.CreateChannelMessage(
+                   channelType=4,
+                   data=pack_any(sim_pb2.SimSpatialChannelData())))
+        s.tick(timeout=0.05)  # flush the create before moving on
+        servers.append((s, ready))
+    for s, ready in servers:
+        end = time.time() + 10
+        while not ready[0] and time.time() < end:
+            s.tick(timeout=0.05)
+        assert ready[0]
+    victim, _ = servers[0]
+    victim_conn_id = victim.id
+    owned = sorted(victim.subscribed_channels)
+    print(f"server spatial0 (conn {victim_conn_id}) owns channels {owned}")
+
+    # Crash: cut the socket without FIN-level cleanliness.
+    victim._sock.close()
+    time.sleep(1.0)  # gateway notices EOF, stashes recoverable subs
+
+    # A replacement process re-authenticates with the same PIT.
+    phoenix = Client(addr)
+    recoveries = []
+    ended = [False]
+    phoenix.add_message_handler(
+        MessageType.RECOVERY_CHANNEL_DATA,
+        lambda c, ch, m: recoveries.append(m.channelId),
+    )
+    phoenix.add_message_handler(
+        MessageType.RECOVERY_END, lambda c, ch, m: ended.__setitem__(0, True)
+    )
+    auth(phoenix, "spatial0")
+    print(f"phoenix authenticated; reclaimed conn id: {phoenix.id} "
+          f"(was {victim_conn_id})")
+    assert phoenix.id == victim_conn_id, "connection id not reclaimed"
+
+    end = time.time() + 10
+    while not ended[0] and time.time() < end:
+        phoenix.tick(timeout=0.05)
+    print(f"recovered {len(recoveries)} channels: {sorted(set(recoveries))}")
+    print(f"RECOVERY_END received: {ended[0]}")
+    assert ended[0] and recoveries, "recovery did not complete"
+    print("RECOVERY DEMO OK")
+
+
+if __name__ == "__main__":
+    main()
