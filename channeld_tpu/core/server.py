@@ -237,6 +237,24 @@ async def run_server(argv: Optional[list[str]] = None) -> None:
     if global_settings.server_conn_recoverable:
         tasks.append(asyncio.ensure_future(connection_recovery_loop()))
 
+    if global_settings.snapshot_path:
+        import os
+
+        from .snapshot import restore_snapshot, snapshot_loop
+
+        if os.path.exists(global_settings.snapshot_path):
+            try:
+                restore_snapshot(global_settings.snapshot_path)
+            except Exception:
+                # A corrupt snapshot must never block boot; start fresh.
+                logger.exception(
+                    "failed to restore snapshot %s; starting with an empty "
+                    "topology", global_settings.snapshot_path,
+                )
+        tasks.append(asyncio.ensure_future(snapshot_loop(
+            global_settings.snapshot_path, global_settings.snapshot_interval_s
+        )))
+
     await start_listening(
         ConnectionType.SERVER,
         global_settings.server_network,

@@ -113,6 +113,10 @@ class GlobalSettings:
     # each main importing its pb package; ours is a flag/config concern).
     import_modules: list[str] = field(default_factory=list)
 
+    # Durable snapshots (new — the reference has no persistence).
+    snapshot_path: str = ""
+    snapshot_interval_s: float = 30.0
+
     # TPU decision-plane settings (new — no reference counterpart).
     spatial_backend: str = "host"  # "host" | "tpu"
     tpu_entity_capacity: int = 1 << 17
@@ -178,6 +182,11 @@ class GlobalSettings:
         p.add_argument("-imports", type=str, default="",
                        help="comma-separated Python modules providing game "
                             "protobuf types (e.g. mygame.data_pb2)")
+        p.add_argument("-snapshot", type=str, default="",
+                       help="path for periodic gateway state snapshots; "
+                            "restored at boot when present")
+        p.add_argument("-snapshot-interval", type=float,
+                       default=self.snapshot_interval_s)
         p.add_argument("-spatial-backend", type=str, default=self.spatial_backend,
                        choices=("host", "tpu"),
                        help="where the AOI/fan-out decision pass runs")
@@ -213,6 +222,8 @@ class GlobalSettings:
         self.max_failed_auth_attempts = args.mfaa
         self.max_fsm_disallowed = args.mfd
         self.spatial_backend = args.spatial_backend
+        self.snapshot_path = args.snapshot
+        self.snapshot_interval_s = args.snapshot_interval
         self.import_modules = [m for m in args.imports.split(",") if m]
         self.load_channel_settings(args.chs)
 

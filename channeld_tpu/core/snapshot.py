@@ -1,0 +1,116 @@
+"""Durable gateway snapshots: channel topology + authoritative data.
+
+Beyond-reference capability (the reference has none; persistence is on
+its roadmap — SURVEY §5). A snapshot captures every channel's id, type,
+metadata, data message and merge options; restoring at boot recreates
+the channels with their state. Connection-bound state (subscriptions,
+owners) is intentionally excluded — connections don't survive a restart;
+the recovery subsystem (connection_recovery.py) restores those when the
+servers reconnect.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+from ..protocol import snapshot_pb2
+from ..utils.anyutil import pack_any, unpack_any
+from ..utils.logger import get_logger
+from .types import ChannelType, GLOBAL_CHANNEL_ID
+
+logger = get_logger("snapshot")
+
+
+def take_snapshot() -> snapshot_pb2.GatewaySnapshot:
+    from .channel import all_channels
+
+    snap = snapshot_pb2.GatewaySnapshot(takenAt=int(time.time()))
+    for ch in all_channels().values():
+        if ch.is_removing():
+            continue
+        entry = snap.channels.add(
+            channelId=ch.id, channelType=ch.channel_type, metadata=ch.metadata
+        )
+        if ch.data is not None and ch.data.msg is not None:
+            entry.data.CopyFrom(pack_any(ch.data.msg))
+            if ch.data.merge_options is not None:
+                entry.mergeOptions.CopyFrom(ch.data.merge_options)
+    return snap
+
+
+def save_snapshot(path: str) -> str:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    snap = take_snapshot()
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(snap.SerializeToString())
+        f.flush()
+        os.fsync(f.fileno())  # data durable before the rename lands
+    os.replace(tmp, path)  # atomic
+    logger.info("saved snapshot of %d channels to %s", len(snap.channels), path)
+    return path
+
+
+def restore_snapshot(path: str) -> int:
+    """Recreate channels from a snapshot file; returns how many. Must run
+    after init_channels (the GLOBAL channel exists, ownerless)."""
+    from .channel import all_channels, create_channel_with_id, get_channel
+
+    with open(path, "rb") as f:
+        snap = snapshot_pb2.GatewaySnapshot()
+        snap.ParseFromString(f.read())
+
+    restored = 0
+    for entry in snap.channels:
+        ch = get_channel(entry.channelId)
+        if ch is None:
+            if entry.channelId == GLOBAL_CHANNEL_ID:
+                continue  # GLOBAL always exists post-init
+            ch = create_channel_with_id(
+                entry.channelId, ChannelType(entry.channelType), None
+            )
+        ch.metadata = entry.metadata
+        if entry.HasField("data"):
+            try:
+                data_msg = unpack_any(entry.data)
+            except Exception:
+                logger.exception(
+                    "failed to restore data for channel %d", entry.channelId
+                )
+                continue
+            merge_options = entry.mergeOptions if entry.HasField("mergeOptions") else None
+            ch.init_data(data_msg, merge_options)
+        restored += 1
+    logger.info("restored %d channels from %s (taken %s)", restored, path,
+                time.strftime("%F %T", time.localtime(snap.takenAt)))
+    return restored
+
+
+async def snapshot_loop(path: str, interval_s: float = 30.0) -> None:
+    """Periodic snapshot writer."""
+    import asyncio
+
+    while True:
+        await asyncio.sleep(max(interval_s, 1.0))
+        try:
+            # take_snapshot touches channel state and must run on the loop;
+            # the serialization + fsync'd write offloads to a thread so
+            # ticks/flushes never stall behind disk IO.
+            snap = take_snapshot()
+
+            def _write(snap=snap):
+                tmp = path + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(snap.SerializeToString())
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)
+
+            await asyncio.to_thread(_write)
+            logger.info(
+                "saved snapshot of %d channels to %s", len(snap.channels), path
+            )
+        except Exception:
+            logger.exception("periodic snapshot failed")

@@ -36,6 +36,7 @@ from ..ops.spatial_ops import (
 )
 
 DATA_AXIS = "entities"
+HOST_AXIS = "hosts"
 
 
 def make_mesh(devices: Optional[list] = None) -> Mesh:
@@ -45,8 +46,24 @@ def make_mesh(devices: Optional[list] = None) -> Mesh:
     return Mesh(np.array(devices, dtype=object).reshape(-1), (DATA_AXIS,))
 
 
+def make_mesh_2d(n_hosts: int, devices: Optional[list] = None) -> Mesh:
+    """Multi-host mesh: a (hosts, entities) grid where the host axis rides
+    DCN and the entity axis rides ICI. Entity arrays shard over BOTH axes
+    (each host's chips own a contiguous slot range); the occupancy psum
+    reduces over ('hosts', 'entities'), so XLA emits the ICI all-reduce
+    within each host and the DCN all-reduce across hosts — the same
+    hierarchy the reference gets from spatial servers + gateway fan-in."""
+    import numpy as np
+
+    devices = devices if devices is not None else jax.devices()
+    arr = np.array(devices, dtype=object).reshape(n_hosts, -1)
+    return Mesh(arr, (HOST_AXIS, DATA_AXIS))
+
+
 def entity_sharding(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, P(DATA_AXIS))
+    """Joint sharding over every mesh axis — matches build_sharded_step's
+    entity spec for both 1D and 2D meshes."""
+    return NamedSharding(mesh, P(tuple(mesh.axis_names)))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
@@ -56,11 +73,14 @@ def replicated(mesh: Mesh) -> NamedSharding:
 def build_sharded_step(grid: GridSpec, mesh: Mesh, max_handovers_per_shard: int):
     """Compile the per-tick decision step sharded over ``mesh``.
 
-    Entity arrays (positions/prev_cell/valid) are sharded on the data
-    axis; queries and subscription state are replicated; outputs:
-    cell_of sharded, handover rows per-shard (gathered), cell counts and
-    AOI masks replicated.
+    Entity arrays (positions/prev_cell/valid) are sharded on the mesh's
+    data axes (single-axis ICI mesh from ``make_mesh``, or the
+    (hosts, entities) DCN x ICI mesh from ``make_mesh_2d``); queries and
+    subscription state are replicated; outputs: cell_of sharded, handover
+    rows per-shard (gathered), cell counts and AOI masks replicated.
     """
+    axes = tuple(mesh.axis_names)  # ("entities",) or ("hosts", "entities")
+    entity_spec = P(axes)  # shard jointly over every mesh axis
 
     def shard_fn(positions, prev_cell, valid, q_kind, q_center, q_extent,
                  q_dir, q_angle, last_ms, interval_ms, active, now_ms):
@@ -70,35 +90,38 @@ def build_sharded_step(grid: GridSpec, mesh: Mesh, max_handovers_per_shard: int)
         ho_count, ho_rows, _reported = compact_handovers(
             handover_mask, prev_cell, cell_of, max_handovers_per_shard
         )
-        # Local slot indices -> global entity slots.
-        shard_index = jax.lax.axis_index(DATA_AXIS)
+        # Local slot indices -> global entity slots (row-major shard order).
+        shard_index = jnp.int32(0)
+        for axis in axes:
+            shard_index = shard_index * jax.lax.axis_size(axis) + jax.lax.axis_index(axis)
         shard_size = positions.shape[0]
         offset = (shard_index * shard_size).astype(jnp.int32)
         ho_rows = ho_rows.at[:, 0].set(
             jnp.where(ho_rows[:, 0] >= 0, ho_rows[:, 0] + offset, -1)
         )
-        # Global per-cell occupancy: the ICI collective that replaces the
-        # reference's cross-server interest border.
-        counts = jax.lax.psum(cell_counts(cell_of, grid.num_cells), DATA_AXIS)
+        # Global per-cell occupancy: reduces over ICI within a host and
+        # DCN across hosts — the collective that replaces the reference's
+        # cross-server interest border.
+        counts = jax.lax.psum(cell_counts(cell_of, grid.num_cells), axes)
         # Replicated decisions computed once per shard (identical inputs).
         interest, dist = aoi_masks(grid, queries)
         due, new_last = fanout_due(now_ms, last_ms, interval_ms, active)
         # Gather every shard's handover rows so the host reads one array.
-        all_counts = jax.lax.all_gather(ho_count, DATA_AXIS)
-        all_rows = jax.lax.all_gather(ho_rows, DATA_AXIS)
+        all_counts = jax.lax.all_gather(ho_count, axes)
+        all_rows = jax.lax.all_gather(ho_rows, axes)
         return cell_of, all_counts, all_rows, counts, interest, dist, due, new_last
 
     sharded = shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(
-            P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),  # positions, prev_cell, valid
+            entity_spec, entity_spec, entity_spec,  # positions, prev_cell, valid
             P(), P(), P(), P(), P(),  # query SoA (replicated)
             P(), P(), P(),  # sub state (replicated)
-            P(),  # now_ns
+            P(),  # now_ms
         ),
         out_specs=(
-            P(DATA_AXIS),  # cell_of
+            entity_spec,  # cell_of
             P(), P(),  # handover counts/rows (gathered, replicated)
             P(), P(), P(), P(), P(),
         ),

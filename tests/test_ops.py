@@ -235,3 +235,43 @@ def test_handover_overflow_redetected_next_tick():
     second = eng.handover_list(r2)
     assert len(second) == 2
     assert {e for e, _, _ in first} | {e for e, _, _ in second} == {100, 101, 102, 103}
+
+
+def test_sharded_step_2d_mesh_matches_single_device():
+    """DCN x ICI (hosts, entities) mesh produces identical decisions."""
+    from channeld_tpu.parallel.mesh import (
+        build_sharded_step,
+        make_mesh_2d,
+        sharded_spatial_step,
+    )
+
+    mesh = make_mesh_2d(2)  # 2 "hosts" x 4 "chips"
+    n = 64
+    rng = np.random.default_rng(5)
+    pts = rng.uniform(-140, 140, size=(n, 3)).astype(np.float32)
+    valid = np.ones(n, bool)
+    prev = np.asarray(assign_cells(GRID, jnp.asarray(pts), jnp.asarray(valid)))
+    moved = pts.copy()
+    moved[::7, 2] += 120
+    queries = QuerySet(
+        kind=jnp.array([AOI_SPHERE], jnp.int32),
+        center=jnp.zeros((1, 2), jnp.float32),
+        extent=jnp.full((1, 2), 90.0, jnp.float32),
+        direction=jnp.ones((1, 2), jnp.float32),
+        angle=jnp.zeros(1, jnp.float32),
+    )
+    sub_state = (jnp.zeros(4, jnp.int32), jnp.full(4, 50, jnp.int32),
+                 jnp.ones(4, bool))
+    step = build_sharded_step(GRID, mesh, max_handovers_per_shard=8)
+    out = sharded_spatial_step(
+        step, jnp.asarray(moved), jnp.asarray(prev), jnp.asarray(valid),
+        queries, sub_state, 60,
+    )
+    new_cells = np.asarray(assign_cells(GRID, jnp.asarray(moved), jnp.asarray(valid)))
+    assert np.array_equal(np.asarray(out["cell_of"]), new_cells)
+    expected_counts = np.asarray(cell_counts(jnp.asarray(new_cells), GRID.num_cells))
+    assert np.array_equal(np.asarray(out["cell_counts"]), expected_counts)
+    crossed = {i for i in range(n) if prev[i] >= 0 and new_cells[i] >= 0
+               and prev[i] != new_cells[i]}
+    rows = np.asarray(out["handovers"]).reshape(-1, 3)
+    assert {int(r[0]) for r in rows if r[0] >= 0} == crossed
