@@ -14,6 +14,7 @@ then:  python examples/spatial_world.py [--entities 32] [--duration 10]
 """
 
 import argparse
+import math
 import os
 import random
 import sys
@@ -29,15 +30,30 @@ from channeld_tpu.protocol import control_pb2, spatial_pb2
 from channeld_tpu.utils.anyutil import pack_any
 
 ENTITY_START = 0x80000
+WORLD_READY = threading.Event()
+
+
+def auth(client: Client, pit: str) -> None:
+    client.auth(pit=pit)
+    end = time.time() + 5
+    while client.id == 0 and time.time() < end:
+        client.tick(timeout=0.05)
+    assert client.id, f"{pit}: auth failed"
+
+
+def connect_with_retry(addr: str, attempts: int = 20) -> Client:
+    """The client listener opens only after GLOBAL is possessed."""
+    for _ in range(attempts):
+        try:
+            return Client(addr)
+        except OSError:
+            time.sleep(0.25)
+    raise ConnectionRefusedError(addr)
 
 
 def run_spatial_server(index: int, args, stats: dict, lock) -> None:
     server = Client(args.server_addr)
-    server.auth(pit=f"spatial{index}")
-    end = time.time() + 5
-    while server.id == 0 and time.time() < end:
-        server.tick(timeout=0.05)
-    assert server.id, f"spatial server {index} auth failed"
+    auth(server, f"spatial{index}")
 
     my_channels: list[int] = []
     handovers = [0]
@@ -52,7 +68,7 @@ def run_spatial_server(index: int, args, stats: dict, lock) -> None:
     ready = [False]
     server.add_message_handler(
         MessageType.SPATIAL_CHANNELS_READY,
-        lambda c, ch, m: ready.__setitem__(0, True),
+        lambda c, ch, m: (ready.__setitem__(0, True), WORLD_READY.set()),
     )
     server.send(
         0, BroadcastType.NO_BROADCAST, MessageType.CREATE_SPATIAL_CHANNEL,
@@ -115,6 +131,7 @@ def run_spatial_server(index: int, args, stats: dict, lock) -> None:
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--server-addr", default="127.0.0.1:11288")
+    p.add_argument("--client-addr", default="127.0.0.1:12108")
     p.add_argument("--servers", type=int, default=4)
     p.add_argument("--entities-per-server", type=int, default=8)
     p.add_argument("--duration", type=float, default=10.0)
@@ -123,16 +140,42 @@ def main() -> None:
     # Master server: owns GLOBAL so the client listener opens and entity
     # ownership inference works.
     master = Client(args.server_addr)
-    master.auth(pit="master")
-    end = time.time() + 5
-    while master.id == 0 and time.time() < end:
-        master.tick(timeout=0.05)
-    assert master.id, "master auth failed"
+    auth(master, "master")
     master.send(
         0, BroadcastType.NO_BROADCAST, MessageType.CREATE_CHANNEL,
         control_pb2.CreateChannelMessage(channelType=1),
     )
     master.tick(timeout=0.2)
+
+    # A player client with a cone-of-vision interest, managed by its
+    # spatial server (ref: the UE flow — servers send
+    # UPDATE_SPATIAL_INTEREST on the client's behalf; the client then
+    # streams damped fan-outs from the cells in view).
+    player = connect_with_retry(args.client_addr)
+    auth(player, "player1")
+    fanouts = [0]
+    player.add_message_handler(
+        MessageType.CHANNEL_DATA_UPDATE,
+        lambda c, ch, m: fanouts.__setitem__(0, fanouts[0] + 1),
+    )
+    interest_mgr = Client(args.server_addr)
+    auth(interest_mgr, "interest-mgr")
+
+    def update_player_interest(x, z, dir_x, dir_z):
+        q = spatial_pb2.SpatialInterestQuery(
+            coneAOI=spatial_pb2.SpatialInterestQuery.ConeAOI(
+                center=spatial_pb2.SpatialInfo(x=x, z=z),
+                direction=spatial_pb2.SpatialInfo(x=dir_x, z=dir_z),
+                radius=120.0, angle=0.9,
+            )
+        )
+        # Sent to a spatial channel; that channel's task diffs + applies.
+        interest_mgr.send(
+            0x10000, BroadcastType.NO_BROADCAST,
+            MessageType.UPDATE_SPATIAL_INTEREST,
+            spatial_pb2.UpdateSpatialInterestMessage(connId=player.id, query=q),
+        )
+        interest_mgr.tick(timeout=0.05)
 
     stats = {"moves": 0, "handovers": 0, "channels": 0}
     lock = threading.Lock()
@@ -145,13 +188,33 @@ def main() -> None:
     for t in threads:
         t.start()
         time.sleep(0.1)
+
+    # The player sweeps its view cone across the world (one revolution per
+    # ~4s, fixed cadence) while entities move. Wait for the world first:
+    # interest updates target spatial channels, which exist only after
+    # every server's CREATE_SPATIAL_CHANNEL is processed.
+    assert WORLD_READY.wait(timeout=20), "world never became ready"
+    end = time.time() + args.duration
+    start = time.time()
+    next_update = 0.0
+    while time.time() < end:
+        now = time.time()
+        if now >= next_update:
+            angle = (now - start) * (2 * math.pi / 4.0)
+            update_player_interest(0.0, 0.0, math.cos(angle), math.sin(angle))
+            next_update = now + 0.2  # 5 Hz interest churn
+        player.tick(timeout=0.05)
     for t in threads:
         t.join()
     print(
         f"{args.servers} spatial servers x {args.entities_per_server} entities, "
         f"{args.duration}s: {stats['channels']} spatial channels, "
         f"{stats['moves']} movement updates, "
-        f"{stats['handovers']} handover messages observed"
+        f"{stats['handovers']} handover messages observed; "
+        f"player received {fanouts[0]} AOI fan-outs "
+        f"({len([c for c in player.subscribed_channels if c < ENTITY_START])} "
+        f"cells + {len([c for c in player.subscribed_channels if c >= ENTITY_START])} "
+        f"entity channels in view at the end)"
     )
 
 
