@@ -162,6 +162,7 @@ class Connection:
 
         channel = get_channel(mp.channelId)
         if channel is None:
+            metrics.packet_dropped.labels(conn_type=self.connection_type.name).inc()
             if mp.msgType not in (
                 MessageType.SUB_TO_CHANNEL,
                 MessageType.UNSUB_FROM_CHANNEL,
@@ -173,6 +174,7 @@ class Connection:
 
         entry = MESSAGE_MAP.get(mp.msgType)
         if entry is None and mp.msgType < MessageType.USER_SPACE_START:
+            metrics.packet_dropped.labels(conn_type=self.connection_type.name).inc()
             self.logger.error("undefined message type %d", mp.msgType)
             return
 

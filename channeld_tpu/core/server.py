@@ -210,7 +210,13 @@ async def flush_loop(interval: float = 0.001) -> None:
 async def run_server(argv: Optional[list[str]] = None) -> None:
     """Full bootstrap (ref: cmd/main.go:12-56)."""
     global_settings.parse_flags(argv)
-    init_logs(development=global_settings.development)
+    # Map the reference's zap levels (-1 Debug..2 Error) onto logging.
+    level_map = {-4: 4, -3: 6, -2: 8, -1: 10, 0: 20, 1: 30, 2: 40}
+    init_logs(
+        level=level_map.get(global_settings.log_level, 20),
+        log_file=global_settings.log_file,
+        development=global_settings.development,
+    )
     if global_settings.profile:
         from .profiling import start_profiling
 
@@ -255,11 +261,18 @@ async def run_server(argv: Optional[list[str]] = None) -> None:
             global_settings.snapshot_path, global_settings.snapshot_interval_s
         )))
 
-    await start_listening(
-        ConnectionType.SERVER,
-        global_settings.server_network,
-        global_settings.server_address,
-    )
+    try:
+        await start_listening(
+            ConnectionType.SERVER,
+            global_settings.server_network,
+            global_settings.server_address,
+        )
+    except OSError as e:
+        logger.error(
+            "cannot listen on %s %s: %s", global_settings.server_network,
+            global_settings.server_address, e,
+        )
+        raise SystemExit(1)
     if global_settings.client_network_wait_master_server:
         logger.info("waiting for the GLOBAL channel to be possessed...")
         await events.global_channel_possessed.wait()
