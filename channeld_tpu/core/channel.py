@@ -322,14 +322,12 @@ class Channel:
 
         self.tick_frames += 1
         self._tick_messages(tick_start)
+        fanout_start = time.monotonic()
+        tick_data(self, now)
         if self.subscribed_connections:
-            fanout_start = time.monotonic()
-            tick_data(self, now)
             metrics.fanout_decision_latency.labels(backend="host").observe(
                 time.monotonic() - fanout_start
             )
-        else:
-            tick_data(self, now)
         self._tick_connections()
         self._tick_recoverable_subscriptions()
 

@@ -147,12 +147,18 @@ class Connection:
             metrics.packet_received.labels(conn_type=ct_name).inc()
             if self._is_packet_recording_enabled() and self.replay_session is not None:
                 self.replay_session.record(packet)
+            dropped_any = False
             for mp in packet.messages:
-                self.receive_message(mp)
+                if not self.receive_message(mp):
+                    dropped_any = True
+            if dropped_any:
+                # Counted once per packet (the reference's packet-level
+                # dropped counter), whatever the drop reason.
+                metrics.packet_dropped.labels(conn_type=ct_name).inc()
 
-    def receive_message(self, mp: wire_pb2.MessagePack) -> None:
-        """Dispatch one message pack to its channel queue
-        (ref: connection.go:547-615)."""
+    def receive_message(self, mp: wire_pb2.MessagePack) -> bool:
+        """Dispatch one message pack to its channel queue; False when the
+        message was dropped (ref: connection.go:547-615)."""
         from .channel import get_channel
         from .message import (
             MESSAGE_MAP,
@@ -162,7 +168,6 @@ class Connection:
 
         channel = get_channel(mp.channelId)
         if channel is None:
-            metrics.packet_dropped.labels(conn_type=self.connection_type.name).inc()
             if mp.msgType not in (
                 MessageType.SUB_TO_CHANNEL,
                 MessageType.UNSUB_FROM_CHANNEL,
@@ -170,13 +175,12 @@ class Connection:
                 self.logger.warning(
                     "can't find channel %d for msgType %d", mp.channelId, mp.msgType
                 )
-            return
+            return False
 
         entry = MESSAGE_MAP.get(mp.msgType)
         if entry is None and mp.msgType < MessageType.USER_SPACE_START:
-            metrics.packet_dropped.labels(conn_type=self.connection_type.name).inc()
             self.logger.error("undefined message type %d", mp.msgType)
-            return
+            return False
 
         if self.fsm is not None and not self.fsm.is_allowed(mp.msgType):
             events.fsm_disallowed.broadcast(
@@ -187,7 +191,7 @@ class Connection:
                 mp.msgType,
                 self.fsm.current.name,
             )
-            return
+            return False
 
         if mp.msgType >= MessageType.USER_SPACE_START and entry is None:
             if self.connection_type == ConnectionType.CLIENT:
@@ -202,7 +206,7 @@ class Connection:
                     msg.ParseFromString(mp.msgBody)
                 except Exception:
                     self.logger.exception("unmarshalling ServerForwardMessage")
-                    return
+                    return False
                 handler = handle_server_to_client_user_message
         else:
             tmpl = entry.template
@@ -213,7 +217,7 @@ class Connection:
                 msg.ParseFromString(mp.msgBody)
             except Exception:
                 self.logger.exception("unmarshalling message type %d", mp.msgType)
-                return
+                return False
             handler = entry.handler
 
         if self.fsm is not None:
@@ -225,6 +229,7 @@ class Connection:
             channel_type=channel.channel_type.name,
             msg_type=str(mp.msgType),
         ).inc()
+        return True
 
     # ---- send path -------------------------------------------------------
 

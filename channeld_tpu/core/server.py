@@ -210,10 +210,15 @@ async def flush_loop(interval: float = 0.001) -> None:
 async def run_server(argv: Optional[list[str]] = None) -> None:
     """Full bootstrap (ref: cmd/main.go:12-56)."""
     global_settings.parse_flags(argv)
-    # Map the reference's zap levels (-1 Debug..2 Error) onto logging.
+    # Map the reference's zap levels (-4 Trace..2 Error) onto logging,
+    # clamping out-of-range values toward the nearest end.
     level_map = {-4: 4, -3: 6, -2: 8, -1: 10, 0: 20, 1: 30, 2: 40}
+    zap_level = global_settings.log_level
+    if zap_level is None:
+        zap_level = 0
+    zap_level = max(-4, min(2, zap_level))
     init_logs(
-        level=level_map.get(global_settings.log_level, 20),
+        level=level_map[zap_level],
         log_file=global_settings.log_file,
         development=global_settings.development,
     )

@@ -34,8 +34,10 @@ def runtime():
     yield gch
 
 
-def run_gateway_and_client(network: str, port: int, client_addr: str):
-    """Run listeners in an asyncio loop thread; drive a sync Client."""
+def run_gateway_and_client(network: str, port: int, client_addr: str,
+                           body=None):
+    """Run listeners in an asyncio loop thread; drive an authed sync
+    Client, then optionally run ``body(client)`` for extra steps."""
     from channeld_tpu.core.channel import get_global_channel
 
     loop = asyncio.new_event_loop()
@@ -59,11 +61,13 @@ def run_gateway_and_client(network: str, port: int, client_addr: str):
         from channeld_tpu.client import Client
 
         client = Client(client_addr)
-        client.auth(pit="ws-test")
+        client.auth(pit="transport-test")
         end = time.time() + 5
         while client.id == 0 and time.time() < end:
             client.tick(timeout=0.05)
         assert client.id != 0, f"auth over {network} failed"
+        if body is not None:
+            body(client)
         client.disconnect()
     finally:
         stop.set()
@@ -141,3 +145,33 @@ def test_rudp_survives_packet_loss():
     t.join(timeout=2)
     client.close()
     assert bytes(received[: len(payload)]) == payload
+
+
+def test_client_stub_rpc_callback():
+    """stubId round trip: the callback fires exactly once with the reply
+    (ref: pkg/client client.go:278-300 stubCallbacks)."""
+    import time
+
+    from channeld_tpu.core.types import BroadcastType, MessageType
+    from channeld_tpu.protocol import control_pb2
+
+    def body(client):
+        replies = []
+        client.send(
+            0, BroadcastType.NO_BROADCAST, MessageType.LIST_CHANNEL,
+            control_pb2.ListChannelMessage(),
+            callback=lambda c, ch, m: replies.append(m),
+        )
+        end = time.time() + 5
+        while not replies and time.time() < end:
+            client.tick(timeout=0.05)
+        assert len(replies) == 1
+        assert isinstance(replies[0], control_pb2.ListChannelResultMessage)
+        # One-shot: a later unrelated reply won't re-fire the callback.
+        client.send(0, BroadcastType.NO_BROADCAST, MessageType.LIST_CHANNEL,
+                    control_pb2.ListChannelMessage())
+        time.sleep(0.3)
+        client.tick(timeout=0.1)
+        assert len(replies) == 1
+
+    run_gateway_and_client("tcp", 23192, "127.0.0.1:23192", body=body)
