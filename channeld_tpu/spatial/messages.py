@@ -54,6 +54,57 @@ def sub_options_for_distance(dist: int) -> control_pb2.ChannelSubscriptionOption
     )
 
 
+def apply_interest_diff(conn, desired: dict, origin_channel=None,
+                        origin_channel_id: int = 0, stub_id: int = 0) -> None:
+    """Diff ``desired`` ({channel_id: grid_distance}) against the
+    connection's current spatial subscriptions and enqueue sub/unsub into
+    each target channel's own queue (ref: message_spatial.go:82-129).
+    Desired channels are always (re)subscribed so distance-damped options
+    refresh via the sub-merge."""
+    from ..core.channel import get_channel
+    from ..core.message import (
+        MessageContext,
+        handle_sub_to_channel,
+        handle_unsub_from_channel,
+    )
+
+    to_unsub = set(conn.spatial_subscriptions.keys()) - set(desired.keys())
+    for ch_id in to_unsub:
+        target = get_channel(ch_id)
+        if target is None:
+            continue
+        unsub_ctx = MessageContext(
+            msg_type=MessageType.UNSUB_FROM_CHANNEL,
+            msg=control_pb2.UnsubscribedFromChannelMessage(connId=conn.id),
+            connection=conn,
+            channel=target,
+            channel_id=origin_channel_id or ch_id,
+            stub_id=stub_id,
+        )
+        if target is origin_channel:
+            handle_unsub_from_channel(unsub_ctx)
+        else:
+            target.put_message_context(unsub_ctx, handle_unsub_from_channel)
+
+    for ch_id, dist in desired.items():
+        target = get_channel(ch_id)
+        if target is None:
+            continue
+        sub_ctx = MessageContext(
+            msg_type=MessageType.SUB_TO_CHANNEL,
+            msg=control_pb2.SubscribedToChannelMessage(
+                connId=conn.id, subOptions=sub_options_for_distance(dist)
+            ),
+            connection=conn,
+            channel=target,
+            channel_id=origin_channel_id or ch_id,
+        )
+        if target is origin_channel:
+            handle_sub_to_channel(sub_ctx)
+        else:
+            target.put_message_context(sub_ctx, handle_sub_to_channel)
+
+
 def handle_update_spatial_interest(ctx) -> None:
     """Query -> desired sub set -> diff against current -> cross-channel
     sub/unsub (ref: message_spatial.go:41-129). Runs in a spatial channel."""
@@ -76,53 +127,49 @@ def handle_update_spatial_interest(ctx) -> None:
     if client_conn is None:
         logger.error("cannot update spatial interest: no connection %d", msg.connId)
         return
+
+    # channeld-tpu extension: a followEntityId hands the query to the device
+    # decision plane, which re-centers it on the entity and re-diffs the
+    # subscriptions every batched tick. A plain query cancels any follow;
+    # shapes the device table can't hold (spots) fall through to the host
+    # path below.
+    register = getattr(controller, "register_follow_interest", None)
+    unregister = getattr(controller, "unregister_follow_interest", None)
+    if callable(register):
+        params = _query_to_engine_params(msg.query) if msg.followEntityId else None
+        if msg.followEntityId and params is not None:
+            kind, extent, direction, angle = params
+            register(client_conn, msg.followEntityId, kind, extent, direction, angle)
+            return
+        if callable(unregister):
+            unregister(client_conn.id)
+
     try:
         spatial_ch_ids = controller.query_channel_ids(msg.query)
     except ValueError as e:
         logger.error("error querying spatial channel ids: %s", e)
         return
 
-    channels_to_sub = {
-        ch_id: sub_options_for_distance(dist) for ch_id, dist in spatial_ch_ids.items()
-    }
-    existing = set(client_conn.spatial_subscriptions.keys())
-    to_unsub = existing - set(channels_to_sub.keys())
+    apply_interest_diff(
+        client_conn, dict(spatial_ch_ids),
+        origin_channel=ctx.channel, origin_channel_id=ctx.channel_id,
+        stub_id=ctx.stub_id,
+    )
 
-    for ch_id in to_unsub:
-        target = get_channel(ch_id)
-        if target is None:
-            continue
-        unsub_ctx = MessageContext(
-            msg_type=MessageType.UNSUB_FROM_CHANNEL,
-            msg=control_pb2.UnsubscribedFromChannelMessage(connId=msg.connId),
-            connection=client_conn,
-            channel=target,
-            channel_id=ctx.channel_id,
-            stub_id=ctx.stub_id,
-        )
-        # Sub/unsub must run inside the *target* channel's execution context.
-        if target is ctx.channel:
-            handle_unsub_from_channel(unsub_ctx)
-        else:
-            target.put_message_context(unsub_ctx, handle_unsub_from_channel)
 
-    for ch_id, sub_options in channels_to_sub.items():
-        target = get_channel(ch_id)
-        if target is None:
-            continue
-        sub_ctx = MessageContext(
-            msg_type=MessageType.SUB_TO_CHANNEL,
-            msg=control_pb2.SubscribedToChannelMessage(
-                connId=msg.connId, subOptions=sub_options
-            ),
-            connection=client_conn,
-            channel=target,
-            channel_id=ctx.channel_id,
-        )
-        if target is ctx.channel:
-            handle_sub_to_channel(sub_ctx)
-        else:
-            target.put_message_context(sub_ctx, handle_sub_to_channel)
+def _query_to_engine_params(query: spatial_pb2.SpatialInterestQuery):
+    """Map a proto query shape onto the device query table's SoA row
+    (ref: ops/spatial_ops.py QuerySet). Spots queries stay host-side."""
+    from ..ops.spatial_ops import AOI_BOX, AOI_CONE, AOI_SPHERE
+
+    if query.HasField("sphereAOI"):
+        return AOI_SPHERE, (query.sphereAOI.radius, 0.0), (1.0, 0.0), 0.0
+    if query.HasField("boxAOI"):
+        return AOI_BOX, (query.boxAOI.extent.x, query.boxAOI.extent.z), (1.0, 0.0), 0.0
+    if query.HasField("coneAOI"):
+        c = query.coneAOI
+        return AOI_CONE, (c.radius, 0.0), (c.direction.x, c.direction.z), c.angle
+    return None
 
 
 def handle_create_spatial_channel(ctx, msg: control_pb2.CreateChannelMessage) -> None:

@@ -39,6 +39,9 @@ class TPUSpatialController(StaticGrid2DSpatialController):
         # from the most recent position update (used at batch-detect time).
         self._providers: dict[int, Callable[[int, int], Optional[int]]] = {}
         self._last_positions: dict[int, SpatialInfo] = {}
+        # Auto-following interests (channeld-tpu extension): conn_id ->
+        # (connection, follow_entity_id, kind, extent, direction, angle).
+        self._followers: dict[int, tuple] = {}
 
     def load_config(self, config: dict) -> None:
         super().load_config(config)
@@ -99,9 +102,64 @@ class TPUSpatialController(StaticGrid2DSpatialController):
         self._last_positions.pop(entity_id, None)
         self._providers.pop(entity_id, None)
 
+    # ---- auto-following interest (channeld-tpu extension) ----------------
+
+    def register_follow_interest(
+        self, conn, follow_entity_id: int, kind: int,
+        extent=(0.0, 0.0), direction=(1.0, 0.0), angle: float = 0.0,
+    ) -> None:
+        """The connection's AOI query tracks ``follow_entity_id`` on device:
+        every batched tick re-centers the query on the entity's position
+        and re-diffs the spatial subscriptions from the interest mask —
+        no per-move UPDATE_SPATIAL_INTEREST messages needed."""
+        info = self._last_positions.get(follow_entity_id)
+        center = (info.x, info.z) if info is not None else (0.0, 0.0)
+        self.engine.set_query(conn.id, kind, center, extent, direction, angle)
+        self._followers[conn.id] = {
+            "conn": conn, "entity": follow_entity_id, "kind": kind,
+            "extent": extent, "direction": direction, "angle": angle,
+            "center": center,
+        }
+
+    def unregister_follow_interest(self, conn_id: int) -> None:
+        if self._followers.pop(conn_id, None) is not None:
+            self.engine.remove_query(conn_id)
+
+    def _reap_followers(self) -> None:
+        for conn_id, entry in list(self._followers.items()):
+            if entry["conn"].is_closing():
+                self.unregister_follow_interest(conn_id)
+
+    def _apply_follow_interests(self, result) -> None:
+        from ..spatial.messages import apply_interest_diff
+
+        start = global_settings.spatial_channel_id_start
+        for conn_id, entry in list(self._followers.items()):
+            conn = entry["conn"]
+            if conn.is_closing():
+                self.unregister_follow_interest(conn_id)
+                continue
+            # Re-center on the followed entity for the *next* tick; skip the
+            # query-table write when the entity hasn't moved (the table
+            # upload is O(capacity)).
+            info = self._last_positions.get(entry["entity"])
+            if info is not None and (info.x, info.z) != entry["center"]:
+                self.engine.set_query(
+                    conn_id, entry["kind"], (info.x, info.z),
+                    entry["extent"], entry["direction"], entry["angle"],
+                )
+                entry["center"] = (info.x, info.z)
+            desired = self.engine.interested_cells(result, conn_id)
+            apply_interest_diff(
+                conn, {start + cell: dist for cell, dist in desired.items()}
+            )
+
     def tick(self) -> None:
         super().tick()  # reap closed server connections
-        if self.engine is None or self.engine.entity_count() == 0:
+        if self.engine is None:
+            return
+        self._reap_followers()  # even with no entities tracked
+        if self.engine.entity_count() == 0:
             return
         from ..core import metrics
 
@@ -114,6 +172,8 @@ class TPUSpatialController(StaticGrid2DSpatialController):
         metrics.tpu_entities.set(self.engine.entity_count())
         for entity_id, src_cell, dst_cell in handovers:
             self._run_handover(entity_id, src_cell, dst_cell)
+        if self._followers:
+            self._apply_follow_interests(result)
 
     def _run_handover(self, entity_id: int, src_cell: int, dst_cell: int) -> None:
         """Run the host orchestration for one device-detected crossing."""

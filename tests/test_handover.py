@@ -274,3 +274,57 @@ def test_tpu_controller_handover_parity():
         if ctx.msg_type == MessageType.CHANNEL_DATA_HANDOVER
     ]
     assert len(handovers) == 1
+
+
+def test_tpu_follow_interest_tracks_entity():
+    """channeld-tpu extension: a follow-interest query re-centers on its
+    entity every batched tick and re-diffs the subscriptions."""
+    from channeld_tpu.core.channel import all_channels
+    from channeld_tpu.core.settings import global_settings
+    from channeld_tpu.spatial.controller import SpatialInfo
+    from channeld_tpu.spatial.tpu_controller import TPUSpatialController
+    from channeld_tpu.ops.spatial_ops import AOI_SPHERE
+
+    global_settings.tpu_entity_capacity = 64
+    global_settings.tpu_query_capacity = 8
+
+    ctl = TPUSpatialController()
+    ctl.load_config(dict(WorldOffsetX=0, WorldOffsetZ=0, GridWidth=100,
+                         GridHeight=100, GridCols=3, GridRows=1, ServerCols=1,
+                         ServerRows=1, ServerInterestBorderSize=1))
+    set_spatial_controller(ctl)
+    server = StubConnection(1, ConnectionType.SERVER)
+    ctx = MessageContext(
+        msg_type=MessageType.CREATE_CHANNEL,
+        msg=control_pb2.CreateChannelMessage(),
+        connection=server,
+    )
+    channels = ctl.create_channels(ctx)
+    assert len(channels) == 3
+
+    # The player's avatar entity lives in cell 0.
+    eid = ENTITY_START + 50
+    ctl.track_entity(eid, SpatialInfo(50, 0, 50))
+    player = StubConnection(2, ConnectionType.CLIENT)
+    # handle_unsub_from_channel resolves connections via the registry.
+    from channeld_tpu.core import connection as connection_mod
+
+    connection_mod._all_connections[player.id] = player
+    ctl.register_follow_interest(player, eid, AOI_SPHERE, extent=(40.0, 0.0))
+
+    def run_ticks():
+        ctl.tick()
+        for ch in list(all_channels().values()):
+            ch.tick_once(0)
+
+    run_ticks()
+    run_ticks()  # subs applied in the channels' own queues
+    assert set(player.spatial_subscriptions.keys()) == {START}
+
+    # The avatar walks to cell 2; the interest follows with no message.
+    ctl.notify(SpatialInfo(50, 0, 50), SpatialInfo(250, 0, 50),
+               lambda s, d: eid)
+    run_ticks()   # tick 1: detects crossing, re-centers the query
+    run_ticks()   # tick 2: interest mask reflects the new center; subs diff
+    run_ticks()
+    assert set(player.spatial_subscriptions.keys()) == {START + 2}
