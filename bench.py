@@ -32,7 +32,32 @@ WARMUP = 10
 TARGET_UPDATES_PER_SEC = 100_000 * 30  # 100K entities @ 30Hz
 
 
+def _arm_watchdog(seconds: float) -> None:
+    """The TPU transport can wedge (backend init hangs in C land); emit a
+    diagnosable JSON line and hard-exit instead of hanging the driver."""
+    import os
+    import threading
+
+    def _fire():
+        print(json.dumps({
+            "metric": "aoi_entity_updates_per_sec_at_100k",
+            "value": 0,
+            "unit": "entity-AOI-updates/s",
+            "vs_baseline": 0.0,
+            "error": f"TPU backend unreachable within {seconds:.0f}s "
+                     "(transport wedged?); see BENCH_RESULTS.md for the "
+                     "last good run",
+        }), flush=True)
+        os._exit(2)
+
+    t = threading.Timer(seconds, _fire)
+    t.daemon = True
+    t.start()
+    _arm_watchdog.timer = t
+
+
 def main() -> None:
+    _arm_watchdog(240.0)
     import jax
     import jax.numpy as jnp
 
@@ -111,6 +136,9 @@ def main() -> None:
         prev_cell = out["cell_of"]
         sub_last = out["new_last_fanout_ms"]
     jax.block_until_ready(out["handover_count"])
+    # Backend proved reachable: disarm the watchdog; the measured phases
+    # below have their own natural completion.
+    _arm_watchdog.timer.cancel()
 
     # Single-step blocking latency (dominated by transport RTT when the
     # chip sits behind a tunnel; the gateway never runs un-pipelined).
