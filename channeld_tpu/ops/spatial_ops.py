@@ -85,17 +85,16 @@ def compact_handovers(
     n = handover_mask.shape[0]
     max_out = min(max_out, n)
     count = jnp.sum(handover_mask, dtype=jnp.int32)
-    # Stable order: sort puts handover slots first.
-    order = jnp.argsort(~handover_mask)  # False<True: handovers first
-    idx = order[:max_out]
-    rows = jnp.stack(
-        [idx.astype(jnp.int32), old_cell[idx], new_cell[idx]], axis=1
-    )
+    # Ordinal of each crossing among all crossings (slot order) — an O(N)
+    # scan instead of an O(N log N) sort.
+    rank = jnp.cumsum(handover_mask, dtype=jnp.int32) - 1
+    reported = handover_mask & (rank < max_out)
+    # First max_out crossing slots, in slot order (fixed-size compaction).
+    (idx,) = jnp.nonzero(handover_mask, size=max_out, fill_value=0)
+    idx = idx.astype(jnp.int32)
+    rows = jnp.stack([idx, old_cell[idx], new_cell[idx]], axis=1)
     row_valid = jnp.arange(max_out) < jnp.minimum(count, max_out)
     rows = jnp.where(row_valid[:, None], rows, -1)
-    # Which entities actually made it into the rows: rank within the sort.
-    rank = jnp.zeros(n, jnp.int32).at[order].set(jnp.arange(n, dtype=jnp.int32))
-    reported = handover_mask & (rank < max_out)
     return count, rows, reported
 
 
