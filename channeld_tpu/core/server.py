@@ -222,6 +222,10 @@ async def run_server(argv: Optional[list[str]] = None) -> None:
         log_file=global_settings.log_file,
         development=global_settings.development,
     )
+    if global_settings.log_file:
+        from ..utils.logger import attach_security_log_file
+
+        attach_security_log_file(global_settings.log_file)
     if global_settings.profile:
         from .profiling import start_profiling
 

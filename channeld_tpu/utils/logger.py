@@ -23,6 +23,7 @@ logging.addLevelName(TRACE, "TRACE")
 
 _ROOT_NAME = "channeld_tpu"
 _initialized = False
+_active_format = "%(asctime)s %(levelname)-8s %(name)s: %(message)s"
 
 # Incremented on warn+ records; mirrored into the Prometheus `logs` counter.
 warn_counts: dict[str, int] = {}
@@ -63,7 +64,8 @@ def init_logs(
     root = logging.getLogger(_ROOT_NAME)
     root.handlers.clear()
     root.setLevel(level)
-    fmt = (
+    global _active_format
+    fmt = _active_format = (
         "%(asctime)s %(levelname)-8s %(name)s: %(message)s"
         if development
         else '{"ts":"%(asctime)s","level":"%(levelname)s","logger":"%(name)s","msg":"%(message)s"}'
@@ -89,5 +91,22 @@ def get_logger(name: str = "") -> logging.Logger:
 
 
 def security_logger() -> logging.Logger:
-    """Separate security event stream (ref: logging.go security.log)."""
+    """Separate security event stream; gets its own file next to the main
+    log when file logging is configured (ref: logging.go security.log)."""
     return get_logger("security")
+
+
+def attach_security_log_file(main_log_file: str) -> None:
+    """Route security events to ``security.log`` beside the main log.
+    Re-init safe (replaces any prior file handler) and uses the same
+    format init_logs chose, like the reference's shared zap config."""
+    import os
+
+    sec = get_logger("security")
+    for h in [h for h in sec.handlers if isinstance(h, logging.FileHandler)]:
+        sec.removeHandler(h)
+        h.close()
+    path = os.path.join(os.path.dirname(main_log_file) or ".", "security.log")
+    handler = logging.FileHandler(path)
+    handler.setFormatter(logging.Formatter(_active_format))
+    sec.addHandler(handler)

@@ -221,3 +221,101 @@ def test_sub_notifications_to_sender_target_owner():
     assert new_client not in ch.subscribed_connections
     assert any(c.msg_type == MessageType.UNSUB_FROM_CHANNEL for c in server.sent)
     assert any(c.msg_type == MessageType.UNSUB_FROM_CHANNEL for c in owner.sent)
+
+
+def test_adjacent_channels_broadcast():
+    """ADJACENT_CHANNELS fans a user-space message across the 3x3 spatial
+    neighborhood without duplicates (ref: message.go:186-241)."""
+    from channeld_tpu.core.channel import get_channel
+    from channeld_tpu.models.sim import register_sim_types
+    from channeld_tpu.spatial.controller import set_spatial_controller
+    from channeld_tpu.spatial.grid import StaticGrid2DSpatialController
+    from channeld_tpu.core.subscription import subscribe_to_channel
+
+    register_sim_types()
+    ctl = StaticGrid2DSpatialController()
+    ctl.load_config(dict(WorldOffsetX=0, WorldOffsetZ=0, GridWidth=10,
+                         GridHeight=10, GridCols=3, GridRows=3, ServerCols=1,
+                         ServerRows=1, ServerInterestBorderSize=1))
+    set_spatial_controller(ctl)
+    server = StubConnection(1, ConnectionType.SERVER)
+    ctx = MessageContext(
+        msg_type=MessageType.CREATE_CHANNEL,
+        msg=control_pb2.CreateChannelMessage(),
+        connection=server,
+    )
+    channels = ctl.create_channels(ctx)
+    START = 0x10000
+
+    # A client subscribed to two adjacent cells must receive once; one in a
+    # far corner must not receive.
+    near = StubConnection(2, ConnectionType.CLIENT)
+    far = StubConnection(3, ConnectionType.CLIENT)
+    subscribe_to_channel(near, get_channel(START + 1), None)
+    subscribe_to_channel(near, get_channel(START + 3), None)
+    subscribe_to_channel(far, get_channel(START + 8), None)
+
+    fwd = MessageContext(
+        msg_type=150,
+        msg=wire_pb2.ServerForwardMessage(payload=b"boom"),
+        broadcast=BroadcastType.ADJACENT_CHANNELS,
+        connection=server,
+        channel=get_channel(START + 0),  # corner cell: neighbors 1,3,4
+        channel_id=START + 0,
+    )
+    handle_server_to_client_user_message(fwd)
+    assert len([c for c in near.sent if c.msg_type == 150]) == 1  # deduped
+    assert len([c for c in far.sent if c.msg_type == 150]) == 0
+
+
+def test_follow_interest_spots_falls_back_to_host():
+    """A follow request with a spots query must still produce host-side
+    subscriptions (code-review regression)."""
+    from channeld_tpu.core import connection as connection_mod
+    from channeld_tpu.core.channel import all_channels
+    from channeld_tpu.core.settings import global_settings
+    from channeld_tpu.models.sim import register_sim_types
+    from channeld_tpu.protocol import spatial_pb2
+    from channeld_tpu.spatial.controller import set_spatial_controller
+    from channeld_tpu.spatial.messages import handle_update_spatial_interest
+    from channeld_tpu.spatial.tpu_controller import TPUSpatialController
+    from helpers import FakeTransport
+
+    global_settings.development = True
+    global_settings.tpu_entity_capacity = 32
+    global_settings.tpu_query_capacity = 4
+    register_sim_types()
+    ctl = TPUSpatialController()
+    ctl.load_config(dict(WorldOffsetX=0, WorldOffsetZ=0, GridWidth=10,
+                         GridHeight=10, GridCols=3, GridRows=3, ServerCols=1,
+                         ServerRows=1, ServerInterestBorderSize=1))
+    set_spatial_controller(ctl)
+    server = StubConnection(1, ConnectionType.SERVER)
+    ctl.create_channels(MessageContext(
+        msg_type=MessageType.CREATE_CHANNEL,
+        msg=control_pb2.CreateChannelMessage(),
+        connection=server,
+    ))
+    client = connection_mod.add_connection(FakeTransport(), ConnectionType.CLIENT)
+    client.state = 1
+    from channeld_tpu.core.channel import get_channel
+
+    START = 0x10000
+    q = spatial_pb2.SpatialInterestQuery(
+        spotsAOI=spatial_pb2.SpatialInterestQuery.SpotsAOI(
+            spots=[spatial_pb2.SpatialInfo(x=5, z=5)]
+        )
+    )
+    ictx = MessageContext(
+        msg_type=MessageType.UPDATE_SPATIAL_INTEREST,
+        msg=spatial_pb2.UpdateSpatialInterestMessage(
+            connId=client.id, query=q, followEntityId=0x80001
+        ),
+        connection=server,
+        channel=get_channel(START),
+        channel_id=START,
+    )
+    handle_update_spatial_interest(ictx)
+    for ch in list(all_channels().values()):
+        ch.tick_once(0)
+    assert START in client.spatial_subscriptions
