@@ -112,6 +112,7 @@ class Channel:
         self.logger = get_logger(f"channel.{self.channel_type.name}.{channel_id}")
         self._tick_task: Optional[asyncio.Task] = None
         self._wake = asyncio.Event()
+        self._writer_task = None  # single-writer affinity (dev assertion)
         self.state = ChannelState.OPEN if self.has_owner() else ChannelState.INIT
 
     # ---- identity / time -------------------------------------------------
@@ -306,6 +307,22 @@ class Channel:
     def tick_once(self, now: Optional[int] = None, tick_start: Optional[float] = None) -> None:
         """One synchronous tick; ``now`` is channel time, injectable for
         tests (ref: channel.go:358-387)."""
+        if global_settings.development:
+            # Race detection (the analog of the reference's go test -race
+            # discipline, SURVEY §5): channel state must only ever be
+            # touched from one task — the one that ticks it.
+            try:
+                current = asyncio.current_task()
+            except RuntimeError:
+                current = None
+            if current is not None:
+                if self._writer_task is None:
+                    self._writer_task = current
+                elif self._writer_task is not current and not self._writer_task.done():
+                    self.logger.error(
+                        "single-writer violation: channel %d ticked from a "
+                        "second task", self.id,
+                    )
         if now is None:
             now = self.get_time()
         if tick_start is None:
