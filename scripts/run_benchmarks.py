@@ -91,7 +91,14 @@ def config_3_tps(duration, clients=2000):
 
 def _device_decision_bench(n_entities, steps, handover_heavy=False):
     import numpy as np
+
+    from bench import _preflight_backend
+
+    backend = _preflight_backend()
     import jax
+
+    if backend == "cpu-fallback":
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
     from channeld_tpu.ops.spatial_ops import GridSpec, QuerySet, spatial_step
@@ -158,12 +165,15 @@ def _device_decision_bench(n_entities, steps, handover_heavy=False):
 
         handovers += int(np2.asarray(inflight.popleft()["consume"])[0])
     dt = time.perf_counter() - t0
-    return {
+    row = {
         "steps_per_sec": round(steps / dt, 1),
         "entity_updates_per_sec": round(steps / dt * n_entities),
         "handovers_per_step": round(handovers / steps, 1),
         "hz_target_met": steps / dt >= 30,
     }
+    if backend == "cpu-fallback":
+        row["backend"] = backend
+    return row
 
 
 def config_4_synthetic(steps):
