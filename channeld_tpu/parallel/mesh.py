@@ -21,7 +21,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.spatial_ops import (
@@ -125,7 +125,7 @@ def build_sharded_step(grid: GridSpec, mesh: Mesh, max_handovers_per_shard: int)
             P(), P(),  # handover counts/rows (gathered, replicated)
             P(), P(), P(), P(), P(),
         ),
-        check_rep=False,
+        check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=(1,))
 

@@ -44,15 +44,29 @@ def run_gateway_and_client(network: str, port: int, client_addr: str,
     stop = threading.Event()
 
     async def gateway():
-        await start_listening(ConnectionType.CLIENT, network, f":{port}")
+        server = await start_listening(ConnectionType.CLIENT, network, f":{port}")
         flusher = asyncio.ensure_future(flush_loop())
         gch = get_global_channel()
-        while not stop.is_set():
-            gch.tick_once(gch.get_time())
-            await asyncio.sleep(0.005)
-        flusher.cancel()
+        try:
+            while not stop.is_set():
+                gch.tick_once(gch.get_time())
+                await asyncio.sleep(0.005)
+        finally:
+            flusher.cancel()
+            close = getattr(server, "close", None)
+            if callable(close):
+                close()
+            wait_closed = getattr(server, "wait_closed", None)
+            if callable(wait_closed):
+                await wait_closed()
 
-    t = threading.Thread(target=lambda: loop.run_until_complete(gateway()), daemon=True)
+    def run():
+        try:
+            loop.run_until_complete(gateway())
+        finally:
+            loop.close()
+
+    t = threading.Thread(target=run, daemon=True)
     t.start()
     import time
 
@@ -71,7 +85,7 @@ def run_gateway_and_client(network: str, port: int, client_addr: str,
         client.disconnect()
     finally:
         stop.set()
-        t.join(timeout=2)
+        t.join(timeout=3)
 
 
 def test_tcp_listener_end_to_end():
@@ -117,7 +131,13 @@ def test_rudp_survives_packet_loss():
             await asyncio.sleep(0.01)
         protocol.close()
 
-    t = threading.Thread(target=lambda: loop.run_until_complete(server()), daemon=True)
+    def _run():
+        try:
+            loop.run_until_complete(server())
+        finally:
+            loop.close()
+
+    t = threading.Thread(target=_run, daemon=True)
     t.start()
     import time
 
