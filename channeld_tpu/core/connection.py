@@ -143,6 +143,14 @@ class Connection:
             self.close()
             return
         metrics.bytes_received.labels(conn_type=ct_name).inc(len(data))
+        # Mirror the peer's compression choice (ref: readPacket sets
+        # c.compressionType from the inbound tag): once a peer sends
+        # snappy, replies are compressed too.
+        if (
+            self.decoder.peer_compression == 1
+            and self.compression_type == CompressionType.NO_COMPRESSION
+        ):
+            self.compression_type = CompressionType.SNAPPY
         for packet in packets:
             metrics.packet_received.labels(conn_type=ct_name).inc()
             if self._is_packet_recording_enabled() and self.replay_session is not None:
