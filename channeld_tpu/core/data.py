@@ -14,6 +14,7 @@ used by the TPU decision plane.
 from __future__ import annotations
 
 import time as _time
+from bisect import bisect_left, bisect_right
 from dataclasses import dataclass, field
 from typing import TYPE_CHECKING, Callable, Optional, Protocol, runtime_checkable
 
@@ -149,6 +150,13 @@ class ChannelData:
         else:
             merge_with_options(self.msg, update_msg, self.merge_options, spatial_notifier)
         self.msg_index += 1
+        # The fan-out windowing bisects this buffer, which requires arrival
+        # times to be monotonic; clamp any out-of-order stamp (e.g. a
+        # cross-channel-forwarded context) to the tail.
+        if self.update_msg_buffer:
+            tail = self.update_msg_buffer[-1].arrival_time
+            if arrival_time < tail:
+                arrival_time = tail
         self.update_msg_buffer.append(
             UpdateBufferElement(update_msg, arrival_time, sender_conn_id, self.msg_index)
         )
@@ -168,6 +176,14 @@ def tick_data(channel: "Channel", now: int) -> None:
     data = channel.data
     if data is None or data.msg is None:
         return
+
+    # Buffered updates arrive in channel-time order, so each subscriber's
+    # inclusive [last, last+interval] window (the reference's bounds,
+    # boundary elements delivered twice like data.go:230-258) is a
+    # contiguous slice — O(log B) to locate instead of scanning the whole
+    # ring per subscriber. Built lazily: ticks with no due subscriber pay
+    # nothing.
+    arrivals = None
 
     queue = channel.fan_out_queue
     for foc in list(queue):
@@ -202,23 +218,25 @@ def tick_data(channel: "Channel", now: int) -> None:
             foc.last_message_index = data.msg_index
             latest_fanout_time = now
         elif data.update_msg_buffer:
+            if arrivals is None:
+                arrivals = [be.arrival_time for be in data.update_msg_buffer]
             last_update_time = max(foc.last_fanout_time, 0)
-            for be in data.update_msg_buffer:
+            lo = bisect_left(arrivals, last_update_time)
+            hi = bisect_right(arrivals, next_fanout_time)
+            for be in data.update_msg_buffer[lo:hi]:
                 if be.sender_conn_id == conn.id and cs.options.skipSelfUpdateFanOut:
                     continue
-                if last_update_time <= be.arrival_time <= next_fanout_time:
-                    if not has_ever_merged:
-                        data.accumulated_update_msg.MergeFrom(be.update_msg)
-                    else:
-                        merge_with_options(
-                            data.accumulated_update_msg,
-                            be.update_msg,
-                            data.merge_options,
-                            None,
-                        )
-                    has_ever_merged = True
-                    last_update_time = be.arrival_time
-                    foc.last_message_index = be.message_index
+                if not has_ever_merged:
+                    data.accumulated_update_msg.MergeFrom(be.update_msg)
+                else:
+                    merge_with_options(
+                        data.accumulated_update_msg,
+                        be.update_msg,
+                        data.merge_options,
+                        None,
+                    )
+                has_ever_merged = True
+                foc.last_message_index = be.message_index
             if has_ever_merged:
                 fan_out_data_update(channel, conn, cs, data.accumulated_update_msg)
 
