@@ -139,8 +139,10 @@ class ChannelData:
         arrival_time: int,
         sender_conn_id: int,
         spatial_notifier=None,
+        now_ns: int = None,
     ) -> None:
-        """(ref: data.go:149-173)."""
+        """(ref: data.go:149-173). ``now_ns`` optionally bounds stray
+        arrival stamps to the channel's own clock."""
         if self.msg is None:
             self.msg = update_msg
             logger.info(
@@ -151,12 +153,20 @@ class ChannelData:
             merge_with_options(self.msg, update_msg, self.merge_options, spatial_notifier)
         self.msg_index += 1
         # The fan-out windowing bisects this buffer, which requires arrival
-        # times to be monotonic; clamp any out-of-order stamp (e.g. a
-        # cross-channel-forwarded context) to the tail.
+        # times to be monotonic in this channel's clock. Clamp stray stamps
+        # in both directions (e.g. a context forwarded from another channel
+        # carries that channel's time base): never before the tail, never
+        # ahead of this channel's own now.
         if self.update_msg_buffer:
             tail = self.update_msg_buffer[-1].arrival_time
             if arrival_time < tail:
                 arrival_time = tail
+        if now_ns is not None and arrival_time > now_ns:
+            arrival_time = max(
+                now_ns,
+                self.update_msg_buffer[-1].arrival_time
+                if self.update_msg_buffer else 0,
+            )
         self.update_msg_buffer.append(
             UpdateBufferElement(update_msg, arrival_time, sender_conn_id, self.msg_index)
         )

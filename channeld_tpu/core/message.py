@@ -493,7 +493,8 @@ def handle_channel_data_update(ctx: MessageContext) -> None:
         else:
             ch.set_data_update_conn_id(msg.contextConnId)
     ch.data.on_update(
-        update_msg, ctx.arrival_time, ctx.connection.id, ch.spatial_notifier
+        update_msg, ctx.arrival_time, ctx.connection.id, ch.spatial_notifier,
+        now_ns=ch.get_time(),
     )
 
 

@@ -1,0 +1,94 @@
+"""Master-server pattern: a backend orchestrating clients via the SDK.
+
+The reference's channeld-ue-chat main (examples/channeld-ue-chat/main.go:
+17-65): a master server owns the GLOBAL channel, receives the mirrored
+AuthResultMessage for every client that authenticates, and manages their
+subscriptions server-side — clients never subscribe themselves.
+
+Run the gateway first (plain, no flags needed):
+
+    python -m channeld_tpu -dev -imports channeld_tpu.models.chat
+
+then:  python examples/master_server.py
+and:   python examples/sim_clients.py -n 8 --behavior chat --duration 10
+(the sim clients' own SUB attempts are redundant here; the master has
+already subscribed them the moment they authenticated).
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from channeld_tpu.client import Client
+from channeld_tpu.core.types import BroadcastType, ChannelDataAccess, MessageType
+from channeld_tpu.models import chat_pb2
+from channeld_tpu.protocol import control_pb2
+from channeld_tpu.utils.anyutil import pack_any
+
+
+def main() -> None:
+    addr = sys.argv[1] if len(sys.argv) > 1 else "127.0.0.1:11288"
+    master = Client(addr)
+    master.auth(pit="master-server")
+    end = time.time() + 5
+    while master.id == 0 and time.time() < end:
+        master.tick(timeout=0.05)
+    assert master.id, "master auth failed"
+
+    managed = set()
+
+    def on_auth_mirror(client, channel_id, msg) -> None:
+        """Every client auth is mirrored to the GLOBAL owner; subscribe the
+        newcomer to GLOBAL with write access, server-side."""
+        if msg.connId == master.id or msg.connId in managed:
+            return
+        if msg.result != control_pb2.AuthResultMessage.SUCCESSFUL:
+            return
+        managed.add(msg.connId)
+        master.send(
+            0, BroadcastType.NO_BROADCAST, MessageType.SUB_TO_CHANNEL,
+            control_pb2.SubscribedToChannelMessage(
+                connId=msg.connId,
+                subOptions=control_pb2.ChannelSubscriptionOptions(
+                    dataAccess=ChannelDataAccess.WRITE_ACCESS,
+                    fanOutIntervalMs=50,
+                ),
+            ),
+        )
+        print(f"subscribed client {msg.connId} to GLOBAL", flush=True)
+
+    # Register the mirror handler BEFORE claiming GLOBAL so auths arriving
+    # during startup are never lost.
+    master.add_message_handler(MessageType.AUTH, on_auth_mirror)
+
+    # Own GLOBAL and seed the chat state (this also opens the client
+    # listener when the gateway runs with -cwm true). The result is
+    # confirmed — a second master must fail loudly, not loop silently.
+    seed = chat_pb2.ChatChannelData()
+    m = seed.chatMessages.add()
+    m.sender = "master"
+    m.content = "welcome to the world"
+    m.sendTime = int(time.time() * 1000)
+    master.send(0, BroadcastType.NO_BROADCAST, MessageType.CREATE_CHANNEL,
+                control_pb2.CreateChannelMessage(channelType=1, data=pack_any(seed)))
+    try:
+        _, created = master.wait_for(MessageType.CREATE_CHANNEL, timeout=5)
+    except TimeoutError:
+        raise SystemExit(
+            "could not claim the GLOBAL channel (is another master running?)"
+        )
+    print(f"master (conn {master.id}) owns GLOBAL", flush=True)
+
+    print("managing client subscriptions; ctrl-c to stop", flush=True)
+    try:
+        while master.is_connected():
+            master.tick(timeout=0.1)
+    except KeyboardInterrupt:
+        pass
+    print(f"managed {len(managed)} clients")
+
+
+if __name__ == "__main__":
+    main()
