@@ -139,7 +139,7 @@ class ChannelData:
         arrival_time: int,
         sender_conn_id: int,
         spatial_notifier=None,
-        now_ns: int = None,
+        now_ns: Optional[int] = None,
     ) -> None:
         """(ref: data.go:149-173). ``now_ns`` optionally bounds stray
         arrival stamps to the channel's own clock."""
@@ -157,16 +157,10 @@ class ChannelData:
         # in both directions (e.g. a context forwarded from another channel
         # carries that channel's time base): never before the tail, never
         # ahead of this channel's own now.
-        if self.update_msg_buffer:
-            tail = self.update_msg_buffer[-1].arrival_time
-            if arrival_time < tail:
-                arrival_time = tail
-        if now_ns is not None and arrival_time > now_ns:
-            arrival_time = max(
-                now_ns,
-                self.update_msg_buffer[-1].arrival_time
-                if self.update_msg_buffer else 0,
-            )
+        tail = self.update_msg_buffer[-1].arrival_time if self.update_msg_buffer else 0
+        if now_ns is not None:
+            arrival_time = min(arrival_time, now_ns)
+        arrival_time = max(arrival_time, tail)
         self.update_msg_buffer.append(
             UpdateBufferElement(update_msg, arrival_time, sender_conn_id, self.msg_index)
         )

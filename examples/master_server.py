@@ -22,7 +22,12 @@ import time
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 from channeld_tpu.client import Client
-from channeld_tpu.core.types import BroadcastType, ChannelDataAccess, MessageType
+from channeld_tpu.core.types import (
+    BroadcastType,
+    ChannelDataAccess,
+    ChannelType,
+    MessageType,
+)
 from channeld_tpu.models import chat_pb2
 from channeld_tpu.protocol import control_pb2
 from channeld_tpu.utils.anyutil import pack_any
@@ -59,8 +64,9 @@ def main() -> None:
         )
         print(f"subscribed client {msg.connId} to GLOBAL", flush=True)
 
-    # Register the mirror handler BEFORE claiming GLOBAL so auths arriving
-    # during startup are never lost.
+    # Register the mirror handler before claiming GLOBAL. Note the gateway
+    # only mirrors auths once GLOBAL has an owner (same as the reference) —
+    # clients must connect after this master is up, per the run order above.
     master.add_message_handler(MessageType.AUTH, on_auth_mirror)
 
     # Own GLOBAL and seed the chat state (this also opens the client
@@ -72,7 +78,8 @@ def main() -> None:
     m.content = "welcome to the world"
     m.sendTime = int(time.time() * 1000)
     master.send(0, BroadcastType.NO_BROADCAST, MessageType.CREATE_CHANNEL,
-                control_pb2.CreateChannelMessage(channelType=1, data=pack_any(seed)))
+                control_pb2.CreateChannelMessage(channelType=ChannelType.GLOBAL,
+                                                 data=pack_any(seed)))
     try:
         _, created = master.wait_for(MessageType.CREATE_CHANNEL, timeout=5)
     except TimeoutError:

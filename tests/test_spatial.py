@@ -340,3 +340,37 @@ def test_update_spatial_interest_flow():
     assert START in client.spatial_subscriptions
     assert START + 8 not in client.spatial_subscriptions
     assert len(client.spatial_subscriptions) < 9
+
+
+def test_spatial_server_slot_reclaimed_after_close():
+    """A closed spatial server's grid block frees on the controller tick
+    and a replacement server can claim it (ref: TestCreateSpatialChannels3
+    tail, spatial.go:884-893)."""
+    ctl = make_ctl(GridWidth=33, GridHeight=77, GridCols=2, GridRows=2,
+                   ServerCols=2, ServerRows=2)
+    conns = [StubConnection(30 + i, ConnectionType.SERVER) for i in range(4)]
+    for conn in conns:
+        ctx = MessageContext(
+            msg_type=MessageType.CREATE_CHANNEL,
+            msg=control_pb2.CreateChannelMessage(),
+            connection=conn,
+        )
+        assert len(ctl.create_channels(ctx)) == 1
+    assert ctl._next_server_index() == 4
+
+    # Server 0 dies; the tick reaps its slot.
+    conns[0].close()
+    ctl.tick()
+    assert ctl.server_connections[0] is None
+    assert ctl._next_server_index() == 0
+
+    # A replacement claims the same grid block.
+    phoenix = StubConnection(99, ConnectionType.SERVER)
+    channels = ctl.create_channels(MessageContext(
+        msg_type=MessageType.CREATE_CHANNEL,
+        msg=control_pb2.CreateChannelMessage(),
+        connection=phoenix,
+    ))
+    assert channels[0].id == START
+    assert ctl._next_server_index() == 4
+    assert channels[0].get_owner() is phoenix
