@@ -11,7 +11,7 @@ import pytest
 from channeld_tpu.core.channel import create_channel
 from channeld_tpu.core.data import tick_data
 from channeld_tpu.core.subscription import subscribe_to_channel
-from channeld_tpu.core.types import ChannelType
+from channeld_tpu.core.types import ChannelType, ConnectionType
 from channeld_tpu.models import testdata_pb2
 from channeld_tpu.protocol import control_pb2
 from channeld_tpu.utils.fieldmask import filter_fields
@@ -28,7 +28,7 @@ def runtime():
 
 def test_fanout_timeline():
     """The exact F0..F9 fan-out timeline from the reference design doc."""
-    c0 = StubConnection(1, ChannelType.GLOBAL)  # server-ish owner
+    c0 = StubConnection(1, ConnectionType.SERVER)  # server owner
     c1 = StubConnection(2)
     c2 = StubConnection(3)
 
@@ -242,3 +242,36 @@ def test_update_buffer_overflow_drops_consumed_only():
         )
     # Old entries past every subscriber's window were dropped.
     assert len(ch.data.update_msg_buffer) <= MAX_UPDATE_MSG_BUFFER_SIZE + 1
+
+
+def test_skip_first_fanout():
+    """skipFirstFanOut suppresses the full-state send: the subscriber only
+    sees updates buffered after it joined (ref: subscription.go:72 seeds
+    hadFirstFanOut from the option)."""
+    owner = StubConnection(1, ConnectionType.SERVER)
+    sub = StubConnection(2)
+    ch = create_channel(ChannelType.TEST, owner)
+    ch.init_data(testdata_pb2.TestChannelDataMessage(text="pre", num=7), None)
+
+    cs, _ = subscribe_to_channel(
+        sub, ch, control_pb2.ChannelSubscriptionOptions(
+            fanOutIntervalMs=50, skipFirstFanOut=True),
+    )
+    assert cs is not None
+
+    # Would be the full-state first fan-out; the option suppresses it.
+    tick_data(ch, 100 * MS)
+    assert len(sub.data_updates()) == 0
+
+    # A later update fans out normally — without replaying the "pre" state.
+    # (Windows are [last, last+interval] in channel time, so the 120ms
+    # arrival lands in the window that closes at 150ms, delivered on the
+    # following due tick — same lag as the reference's F2 step.)
+    ch.data.on_update(
+        testdata_pb2.TestChannelDataMessage(text="post"), 120 * MS, owner.id, None
+    )
+    tick_data(ch, 150 * MS)
+    tick_data(ch, 200 * MS)
+    assert len(sub.data_updates()) == 1
+    assert sub.latest_data_update().text == "post"
+    assert sub.latest_data_update().num == 0  # never saw the initial state
