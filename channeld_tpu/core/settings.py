@@ -139,7 +139,12 @@ class GlobalSettings:
 
     def parse_flags(self, argv: Optional[list[str]] = None) -> None:
         """CLI flags, names matching the reference (ref: settings.go:144-235)."""
-        p = argparse.ArgumentParser(prog="channeld-tpu", add_help=True)
+        # allow_abbrev=False: Go's flag package (which the reference CLI
+        # uses) never prefix-matches, and abbreviation lets a typo like
+        # `-imp x` silently bind to -imports.
+        p = argparse.ArgumentParser(
+            prog="channeld-tpu", add_help=True, allow_abbrev=False
+        )
         p.add_argument("-dev", action="store_true", help="run in development mode")
         p.add_argument("-loglevel", type=int, default=None,
                        help="-1 Debug, 0 Info, 1 Warn, 2 Error")
