@@ -124,6 +124,21 @@ class Connection:
         self.spatial_subscriptions: dict[int, object] = {}
         self.recover_handle = None
         self.logger = get_logger(f"conn.{self.connection_type.name}.{conn_id}")
+        # Per-connection labels never change; resolving the labelled
+        # children once keeps prometheus' .labels() tuple-building and
+        # validation out of the per-packet hot path (~8% of active CPU
+        # under a 64-client profile).
+        ct_name = self.connection_type.name
+        self._m_bytes_received = metrics.bytes_received.labels(conn_type=ct_name)
+        self._m_packet_received = metrics.packet_received.labels(conn_type=ct_name)
+        self._m_packet_dropped = metrics.packet_dropped.labels(conn_type=ct_name)
+        self._m_packet_sent = metrics.packet_sent.labels(conn_type=ct_name)
+        self._m_bytes_sent = metrics.bytes_sent.labels(conn_type=ct_name)
+        self._m_packet_combined = metrics.packet_combined.labels(conn_type=ct_name)
+        self._m_msg_sent = metrics.msg_sent.labels(
+            conn_type=ct_name, channel_type="", msg_type=""
+        )
+        self._m_msg_received: dict[tuple, object] = {}
         if self._is_packet_recording_enabled():
             from ..replay.session import ReplaySession
 
@@ -134,15 +149,16 @@ class Connection:
     def on_bytes(self, data: bytes) -> None:
         """Feed raw stream bytes; dispatches every complete packet.
         Fatal framing/parse errors close the connection (ref: readPacket)."""
-        ct_name = self.connection_type.name
         try:
             packets = self.decoder.decode_packets(data)
         except Exception as e:  # framing violations and protobuf DecodeError alike
             self.logger.warning("bad inbound frame, closing connection: %s", e)
-            metrics.connection_closed.labels(conn_type=ct_name).inc()
+            metrics.connection_closed.labels(
+                conn_type=self.connection_type.name
+            ).inc()
             self.close()
             return
-        metrics.bytes_received.labels(conn_type=ct_name).inc(len(data))
+        self._m_bytes_received.inc(len(data))
         # Mirror the peer's compression choice (ref: readPacket sets
         # c.compressionType from the inbound tag): once a peer sends
         # snappy, replies are compressed too.
@@ -152,7 +168,7 @@ class Connection:
         ):
             self.compression_type = CompressionType.SNAPPY
         for packet in packets:
-            metrics.packet_received.labels(conn_type=ct_name).inc()
+            self._m_packet_received.inc()
             if self._is_packet_recording_enabled() and self.replay_session is not None:
                 self.replay_session.record(packet)
             dropped_any = False
@@ -162,7 +178,7 @@ class Connection:
             if dropped_any:
                 # Counted once per packet (the reference's packet-level
                 # dropped counter), whatever the drop reason.
-                metrics.packet_dropped.labels(conn_type=ct_name).inc()
+                self._m_packet_dropped.inc()
 
     def receive_message(self, mp: wire_pb2.MessagePack) -> bool:
         """Dispatch one message pack to its channel queue; False when the
@@ -232,11 +248,15 @@ class Connection:
             self.fsm.on_received(mp.msgType)
 
         channel.put_message(msg, handler, self, mp)
-        metrics.msg_received.labels(
-            conn_type=self.connection_type.name,
-            channel_type=channel.channel_type.name,
-            msg_type=str(mp.msgType),
-        ).inc()
+        key = (channel.channel_type, mp.msgType)
+        child = self._m_msg_received.get(key)
+        if child is None:
+            child = self._m_msg_received[key] = metrics.msg_received.labels(
+                conn_type=self.connection_type.name,
+                channel_type=channel.channel_type.name,
+                msg_type=str(mp.msgType),
+            )
+        child.inc()
         return True
 
     # ---- send path -------------------------------------------------------
@@ -268,20 +288,17 @@ class Connection:
             self.logger.error("packet encode failed, dropping batch: %s", e)
             return
 
-        ct_name = self.connection_type.name
         for frame, count in zip(frames, counts):
             try:
                 self.transport.write(frame)
             except Exception as e:
                 self.logger.error("error writing packet: %s", e)
                 break
-            metrics.packet_sent.labels(conn_type=ct_name).inc()
-            metrics.bytes_sent.labels(conn_type=ct_name).inc(len(frame))
+            self._m_packet_sent.inc()
+            self._m_bytes_sent.inc(len(frame))
             if count > 1:
-                metrics.packet_combined.labels(conn_type=ct_name).inc()
-            metrics.msg_sent.labels(
-                conn_type=ct_name, channel_type="", msg_type="",
-            ).inc(count)
+                self._m_packet_combined.inc()
+            self._m_msg_sent.inc(count)
 
     def _encode_packets_py(self, batch: list[tuple], ct: int):
         """Pure-Python fallback for the native packet builder; returns
