@@ -26,6 +26,7 @@ from .spatial_ops import (
     AOI_CONE,
     AOI_NONE,
     AOI_SPHERE,
+    AOI_SPOTS,
     GridSpec,
     QuerySet,
     spatial_step,
@@ -65,6 +66,13 @@ class SpatialEngine:
         self._q_angle = np.zeros(query_capacity, np.float32)
         self._q_free = list(range(query_capacity - 1, -1, -1))
         self._q_of_conn: dict[int, int] = {}
+        # [Q,C] spots dist table (-1 = no interest), allocated on the
+        # first spots query so the common compiled step never carries it
+        # (one recompile then). The device copy updates by row scatter —
+        # H2D is O(changed rows x C), never the whole table.
+        self._q_spot_dist: Optional[np.ndarray] = None
+        self._d_spot_dist = None
+        self._spot_dirty_rows: set[int] = set()
         self._queries_dirty = True
 
         self._sub_last = np.zeros(sub_capacity, np.int32)
@@ -160,10 +168,56 @@ class SpatialEngine:
         self._q_angle[q] = angle
         self._queries_dirty = True
 
+    def set_spots_query(
+        self,
+        conn_id: int,
+        spots_xz: list[tuple[float, float]],
+        dists: Optional[list[int]] = None,
+    ) -> None:
+        """Spots AOI on the device plane: rasterize the spot list to a
+        per-cell interest row (ref: spatial.go spots loop — each spot's
+        cell, dist = dists[i] when given else 0; out-of-world spots
+        skipped). Where several spots land in one cell the last spot's
+        dist wins — the host path's dict-overwrite order. The row is a
+        dist table with -1 = no interest (see QuerySet.spot_dist)."""
+        import math
+
+        q = self._q_of_conn.get(conn_id)
+        if q is None:
+            if not self._q_free:
+                raise RuntimeError("query capacity exhausted")
+            q = self._q_free.pop()
+            self._q_of_conn[conn_id] = q
+        if self._q_spot_dist is None:
+            self._q_spot_dist = np.full(
+                (self.query_capacity, self.grid.num_cells), -1, np.int32
+            )
+        self._q_kind[q] = AOI_SPOTS
+        dist_row = np.full(self.grid.num_cells, -1, np.int32)
+        g = self.grid
+        for i, (x, z) in enumerate(spots_xz):
+            # Divide-then-floor, exactly like the host path and
+            # assign_cells — float floor-division disagrees on boundaries
+            # (1.0 // 0.1 == 9.0 but floor(1.0 / 0.1) == 10).
+            col = math.floor((x - g.offset_x) / g.cell_w)
+            row = math.floor((z - g.offset_z) / g.cell_h)
+            if not (0 <= col < g.cols and 0 <= row < g.rows):
+                continue
+            cell = row * g.cols + col
+            dist_row[cell] = (
+                int(dists[i]) if dists is not None and i < len(dists) else 0
+            )
+        self._q_spot_dist[q] = dist_row
+        self._spot_dirty_rows.add(q)
+        self._queries_dirty = True
+
     def remove_query(self, conn_id: int) -> None:
         q = self._q_of_conn.pop(conn_id, None)
         if q is not None:
             self._q_kind[q] = AOI_NONE
+            if self._q_spot_dist is not None:
+                self._q_spot_dist[q] = -1
+                self._spot_dirty_rows.add(q)
             self._q_free.append(q)
             self._queries_dirty = True
 
@@ -200,13 +254,29 @@ class SpatialEngine:
             cells = np.fromiter(self._seed_cells.values(), np.int32, len(self._seed_cells))
             self._d_cell = self._d_cell.at[slots].set(cells)
             self._seed_cells.clear()
-        if self._d_queries is None or self._queries_dirty:
+        spots_changed = False
+        if self._q_spot_dist is not None:
+            if self._d_spot_dist is None:
+                self._d_spot_dist = jnp.asarray(self._q_spot_dist)
+                self._spot_dirty_rows.clear()
+                spots_changed = True
+            elif self._spot_dirty_rows:
+                idx = np.fromiter(
+                    self._spot_dirty_rows, np.int32, len(self._spot_dirty_rows)
+                )
+                self._d_spot_dist = self._d_spot_dist.at[idx].set(
+                    self._q_spot_dist[idx]
+                )
+                self._spot_dirty_rows.clear()
+                spots_changed = True
+        if self._d_queries is None or self._queries_dirty or spots_changed:
             self._d_queries = QuerySet(
                 jnp.asarray(self._q_kind),
                 jnp.asarray(self._q_center),
                 jnp.asarray(self._q_extent),
                 jnp.asarray(self._q_dir),
                 jnp.asarray(self._q_angle),
+                self._d_spot_dist,
             )
             self._queries_dirty = False
         if self._d_sub_state is None or self._subs_dirty:

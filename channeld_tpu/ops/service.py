@@ -18,6 +18,7 @@ from typing import Optional
 import numpy as np
 
 from ..utils.logger import get_logger
+from .spatial_ops import AOI_SPOTS
 from .service_pb2 import (
     ConfigRequest,
     Empty,
@@ -73,6 +74,11 @@ class SpatialDecisionServicer:
             for eid in request.removedEntityIds:
                 eng.remove_entity(eid)
             for q in request.queries:
+                if q.kind == AOI_SPOTS:
+                    eng.set_spots_query(
+                        q.connId, list(zip(q.spotX, q.spotZ)), list(q.spotDists)
+                    )
+                    continue
                 direction = (q.dirX, q.dirZ)
                 if direction == (0.0, 0.0):
                     direction = (1.0, 0.0)  # unset; a zero vector is invalid

@@ -22,7 +22,7 @@ Semantics notes vs the host path:
 from __future__ import annotations
 
 from functools import partial
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -115,17 +115,27 @@ AOI_NONE = 0
 AOI_SPHERE = 1
 AOI_BOX = 2
 AOI_CONE = 3
+AOI_SPOTS = 4
 
 
 class QuerySet(NamedTuple):
     """SoA batch of client interest queries (ref: channeld.proto
-    SpatialInterestQuery; one active shape per query)."""
+    SpatialInterestQuery; one active shape per query).
 
-    kind: jnp.ndarray  # i32[Q] in {NONE, SPHERE, BOX, CONE}
+    Spots queries don't reduce to a geometric test, so they ride as a
+    precomputed per-query cell table (rasterized host-side when the query
+    is set — spots change rarely, cells are few): one i32[Q,C] damping
+    distance with -1 meaning "no interest" (the mask is ``dist >= 0``).
+    The field stays ``None`` until the first spots query, keeping the
+    common-case compiled step free of the table.
+    """
+
+    kind: jnp.ndarray  # i32[Q] in {NONE, SPHERE, BOX, CONE, SPOTS}
     center: jnp.ndarray  # f32[Q,2] (x,z)
     extent: jnp.ndarray  # f32[Q,2] box half-extent (x,z); radius in [:,0] for sphere/cone
     direction: jnp.ndarray  # f32[Q,2] cone direction (x,z), normalized
     angle: jnp.ndarray  # f32[Q] cone half-angle, radians
+    spot_dist: Optional[jnp.ndarray] = None  # i32[Q,C]; -1 = no interest
 
 
 def _cell_geometry(grid: GridSpec):
@@ -182,6 +192,14 @@ def aoi_masks(grid: GridSpec, queries: QuerySet):
     dist = jnp.ceil(center_dist / diag).astype(jnp.int32)
     # The query's own cell is distance 0 (ref: result[centerChId] = 0).
     dist = jnp.where(rect_dist <= 0.0, 0, dist)
+    if queries.spot_dist is not None:
+        # Spots: interest and damping distance come straight from the
+        # host-rasterized table (ref: spatial.go spots loop — each spot's
+        # cell with its per-spot dist, default 0; -1 = cell not targeted).
+        is_spots = queries.kind[:, None] == AOI_SPOTS
+        spots_hit = queries.spot_dist >= 0
+        hit = jnp.where(is_spots, spots_hit, hit)
+        dist = jnp.where(is_spots & spots_hit, queries.spot_dist, dist)
     return hit, dist
 
 

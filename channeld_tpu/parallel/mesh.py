@@ -70,7 +70,8 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def build_sharded_step(grid: GridSpec, mesh: Mesh, max_handovers_per_shard: int):
+def build_sharded_step(grid: GridSpec, mesh: Mesh, max_handovers_per_shard: int,
+                       with_spots: bool = False):
     """Compile the per-tick decision step sharded over ``mesh``.
 
     Entity arrays (positions/prev_cell/valid) are sharded on the mesh's
@@ -78,13 +79,23 @@ def build_sharded_step(grid: GridSpec, mesh: Mesh, max_handovers_per_shard: int)
     (hosts, entities) DCN x ICI mesh from ``make_mesh_2d``); queries and
     subscription state are replicated; outputs: cell_of sharded, handover
     rows per-shard (gathered), cell counts and AOI masks replicated.
+
+    ``with_spots=True`` adds the replicated [Q,C] spots dist table to
+    the signature (see QuerySet.spot_dist) — build with it when any
+    query uses SpotsAOI.
     """
     axes = tuple(mesh.axis_names)  # ("entities",) or ("hosts", "entities")
     entity_spec = P(axes)  # shard jointly over every mesh axis
 
     def shard_fn(positions, prev_cell, valid, q_kind, q_center, q_extent,
-                 q_dir, q_angle, last_ms, interval_ms, active, now_ms):
-        queries = QuerySet(q_kind, q_center, q_extent, q_dir, q_angle)
+                 q_dir, q_angle, *rest):
+        if with_spots:
+            spot_dist, last_ms, interval_ms, active, now_ms = rest
+        else:
+            spot_dist = None
+            last_ms, interval_ms, active, now_ms = rest
+        queries = QuerySet(q_kind, q_center, q_extent, q_dir, q_angle,
+                           spot_dist)
         cell_of = assign_cells(grid, positions, valid)
         handover_mask = detect_handovers(prev_cell, cell_of)
         ho_count, ho_rows, _reported = compact_handovers(
@@ -117,6 +128,7 @@ def build_sharded_step(grid: GridSpec, mesh: Mesh, max_handovers_per_shard: int)
         in_specs=(
             entity_spec, entity_spec, entity_spec,  # positions, prev_cell, valid
             P(), P(), P(), P(), P(),  # query SoA (replicated)
+            *((P(),) if with_spots else ()),  # spots dist table (replicated)
             P(), P(), P(),  # sub state (replicated)
             P(),  # now_ms
         ),
@@ -127,16 +139,34 @@ def build_sharded_step(grid: GridSpec, mesh: Mesh, max_handovers_per_shard: int)
         ),
         check_vma=False,
     )
-    return jax.jit(sharded, donate_argnums=(1,))
+    jitted = jax.jit(sharded, donate_argnums=(1,))
+
+    def step(*args):
+        return jitted(*args)
+
+    step.with_spots = with_spots
+    return step
 
 
 def sharded_spatial_step(step_fn, positions, prev_cell, valid, queries: QuerySet,
                          sub_state, now_ms):
     last_ms, interval_ms, active = sub_state
+    if queries.spot_dist is not None and not getattr(step_fn, "with_spots", False):
+        raise ValueError(
+            "queries carry a spots table; build_sharded_step(with_spots=True)"
+        )
+    if queries.spot_dist is None and getattr(step_fn, "with_spots", False):
+        raise ValueError(
+            "step compiled with_spots=True but queries have no spots table"
+        )
+    spot_args = (
+        (queries.spot_dist,) if getattr(step_fn, "with_spots", False) else ()
+    )
     cell_of, ho_counts, ho_rows, counts, interest, dist, due, new_last = step_fn(
         positions, prev_cell, valid,
         queries.kind, queries.center, queries.extent, queries.direction,
-        queries.angle, last_ms, interval_ms, active, jnp.int32(now_ms),
+        queries.angle, *spot_args, last_ms, interval_ms, active,
+        jnp.int32(now_ms),
     )
     return {
         "cell_of": cell_of,

@@ -131,8 +131,9 @@ def handle_update_spatial_interest(ctx) -> None:
     # channeld-tpu extension: a followEntityId hands the query to the device
     # decision plane, which re-centers it on the entity and re-diffs the
     # subscriptions every batched tick. A plain query cancels any follow;
-    # shapes the device table can't hold (spots) fall through to the host
-    # path below.
+    # spots queries fall through to the host path below (absolute points
+    # can't follow an entity — the engine itself serves spots via
+    # set_spots_query for sidecar consumers).
     register = getattr(controller, "register_follow_interest", None)
     unregister = getattr(controller, "unregister_follow_interest", None)
     if callable(register):
@@ -159,7 +160,7 @@ def handle_update_spatial_interest(ctx) -> None:
 
 def _query_to_engine_params(query: spatial_pb2.SpatialInterestQuery):
     """Map a proto query shape onto the device query table's SoA row
-    (ref: ops/spatial_ops.py QuerySet). Spots queries stay host-side."""
+    (ref: ops/spatial_ops.py QuerySet); spots have no follow semantics."""
     from ..ops.spatial_ops import AOI_BOX, AOI_CONE, AOI_SPHERE
 
     if query.HasField("sphereAOI"):

@@ -275,3 +275,117 @@ def test_sharded_step_2d_mesh_matches_single_device():
                and prev[i] != new_cells[i]}
     rows = np.asarray(out["handovers"]).reshape(-1, 3)
     assert {int(r[0]) for r in rows if r[0] >= 0} == crossed
+
+
+def test_engine_spots_query_matches_host():
+    """Device spots AOI (precomputed [Q,C] mask rows) returns the same
+    {cell: dist} map as the host path's spots loop (ref: spatial.go spots
+    AOI), including per-spot dists, out-of-world skips, and the lazy
+    table allocation mid-engine-life."""
+    from channeld_tpu.protocol import spatial_pb2
+
+    eng = SpatialEngine(GRID, entity_capacity=16, query_capacity=8,
+                        sub_capacity=8, max_handovers=8)
+    eng.add_entity(1, 0, 0, 0)
+    # A geometric query first: the spots tables must attach lazily later
+    # without disturbing existing rows.
+    eng.set_query(3, AOI_SPHERE, (0.0, 0.0), (40.0, 0.0))
+    r0 = eng.tick(now_ms=0)
+    assert eng.interested_cells(r0, 3) == {4: 0}
+
+    # Two spots share cell 5 with different dists: last-wins like the
+    # host dict; the exact-boundary spot (x=-50 = a cell edge) pins the
+    # divide-then-floor parity; 6th spot is out of world, no dist ->
+    # skipped.
+    spots = [(-100.0, -100.0), (120.0, 0.0), (130.0, 10.0), (0.0, 120.0),
+             (-50.0, 0.0), (999.0, 0.0)]
+    dists = [2, 9, 1, 0, 5]
+    eng.set_spots_query(9, spots, dists)
+    r1 = eng.tick(now_ms=50)
+
+    ctl = host_controller()
+    q = spatial_pb2.SpatialInterestQuery()
+    for x, z in spots:
+        s = q.spotsAOI.spots.add()
+        s.x, s.z = x, z
+    q.spotsAOI.dists.extend(dists)
+    expected = {ch - START: d for ch, d in ctl.query_channel_ids(q).items()}
+
+    assert eng.interested_cells(r1, 9) == expected
+    # The earlier geometric query is untouched by the table attach.
+    assert eng.interested_cells(r1, 3) == {4: 0}
+
+    # Removing the spots query clears its mask row for slot reuse.
+    eng.remove_query(9)
+    r2 = eng.tick(now_ms=100)
+    assert eng.interested_cells(r2, 9) == {}
+
+
+def test_sharded_step_spots_queries():
+    """Spots tables ride the sharded step as replicated inputs and yield
+    the same interest rows as the single-device engine; a spots QuerySet
+    against a step compiled without with_spots fails loudly."""
+    from channeld_tpu.ops.spatial_ops import AOI_SPOTS
+    from channeld_tpu.parallel.mesh import (
+        build_sharded_step,
+        make_mesh,
+        sharded_spatial_step,
+    )
+
+    mesh = make_mesh()
+    n = 64
+    rng = np.random.default_rng(5)
+    pts = rng.uniform(-140, 140, size=(n, 3)).astype(np.float32)
+    valid = np.ones(n, bool)
+    prev = np.asarray(assign_cells(GRID, jnp.asarray(pts), jnp.asarray(valid)))
+
+    spot_dist = np.full((2, GRID.num_cells), -1, np.int32)
+    spot_dist[0, [0, 5, 7]] = [2, 1, 0]
+    queries = QuerySet(
+        kind=jnp.array([AOI_SPOTS, AOI_SPHERE], jnp.int32),
+        center=jnp.array([[0, 0], [0, 0]], jnp.float32),
+        extent=jnp.array([[0, 0], [40, 0]], jnp.float32),
+        direction=jnp.array([[1, 0], [1, 0]], jnp.float32),
+        angle=jnp.zeros(2, jnp.float32),
+        spot_dist=jnp.asarray(spot_dist),
+    )
+    sub_state = (
+        jnp.zeros(2, jnp.int32),
+        jnp.full(2, 50, jnp.int32),
+        jnp.ones(2, bool),
+    )
+    step = build_sharded_step(GRID, mesh, max_handovers_per_shard=16,
+                              with_spots=True)
+    out = sharded_spatial_step(step, jnp.asarray(pts), jnp.asarray(prev),
+                               jnp.asarray(valid), queries, sub_state, 60)
+    interest = np.asarray(out["interest"])
+    dist = np.asarray(out["dist"])
+    assert sorted(np.nonzero(interest[0])[0].tolist()) == [0, 5, 7]
+    assert [int(dist[0, c]) for c in (0, 5, 7)] == [2, 1, 0]
+    # The geometric query in the same batch is unaffected.
+    assert bool(interest[1, 4])
+
+    plain_step = build_sharded_step(GRID, mesh, max_handovers_per_shard=16)
+    with pytest.raises(ValueError, match="with_spots"):
+        sharded_spatial_step(plain_step, jnp.asarray(pts), jnp.asarray(prev),
+                             jnp.asarray(valid), queries, sub_state, 60)
+
+
+def test_engine_spots_incremental_row_update():
+    """Changing one spots row after the tables attach re-uploads only that
+    row (device tables updated by scatter) and the tick reflects it."""
+    eng = SpatialEngine(GRID, entity_capacity=16, query_capacity=8,
+                        sub_capacity=8, max_handovers=8)
+    eng.add_entity(1, 0, 0, 0)
+    eng.set_spots_query(9, [(-100.0, -100.0)])
+    r1 = eng.tick(now_ms=0)
+    assert eng.interested_cells(r1, 9) == {0: 0}
+    before = eng._d_spot_dist
+
+    eng.set_spots_query(9, [(120.0, 0.0), (0.0, 120.0)], [3, 4])
+    assert eng._spot_dirty_rows  # staged, not yet uploaded
+    r2 = eng.tick(now_ms=50)
+    assert eng.interested_cells(r2, 9) == {5: 3, 7: 4}
+    assert not eng._spot_dirty_rows
+    # Second query triggers the lazy-attach only once.
+    assert eng._d_spot_dist is not before  # scatter produced a new buffer
