@@ -145,6 +145,15 @@ class SpatialEngine:
 
     # ---- queries ---------------------------------------------------------
 
+    def _query_slot(self, conn_id: int) -> int:
+        q = self._q_of_conn.get(conn_id)
+        if q is None:
+            if not self._q_free:
+                raise RuntimeError("query capacity exhausted")
+            q = self._q_free.pop()
+            self._q_of_conn[conn_id] = q
+        return q
+
     def set_query(
         self,
         conn_id: int,
@@ -154,12 +163,7 @@ class SpatialEngine:
         direction_xz: tuple[float, float] = (1.0, 0.0),
         angle: float = 0.0,
     ) -> None:
-        q = self._q_of_conn.get(conn_id)
-        if q is None:
-            if not self._q_free:
-                raise RuntimeError("query capacity exhausted")
-            q = self._q_free.pop()
-            self._q_of_conn[conn_id] = q
+        q = self._query_slot(conn_id)
         self._q_kind[q] = kind
         self._q_center[q] = center_xz
         self._q_extent[q] = extent_xz
@@ -182,12 +186,7 @@ class SpatialEngine:
         dist table with -1 = no interest (see QuerySet.spot_dist)."""
         import math
 
-        q = self._q_of_conn.get(conn_id)
-        if q is None:
-            if not self._q_free:
-                raise RuntimeError("query capacity exhausted")
-            q = self._q_free.pop()
-            self._q_of_conn[conn_id] = q
+        q = self._query_slot(conn_id)
         if self._q_spot_dist is None:
             self._q_spot_dist = np.full(
                 (self.query_capacity, self.grid.num_cells), -1, np.int32
@@ -204,8 +203,11 @@ class SpatialEngine:
             if not (0 <= col < g.cols and 0 <= row < g.rows):
                 continue
             cell = row * g.cols + col
+            # Clamp to int32 max: wire dists are uint32, and 0xFFFFFFFF
+            # must not alias the -1 sentinel.
             dist_row[cell] = (
-                int(dists[i]) if dists is not None and i < len(dists) else 0
+                min(int(dists[i]), 2**31 - 1)
+                if dists is not None and i < len(dists) else 0
             )
         self._q_spot_dist[q] = dist_row
         self._spot_dirty_rows.add(q)

@@ -75,6 +75,14 @@ class SpatialDecisionServicer:
                 eng.remove_entity(eid)
             for q in request.queries:
                 if q.kind == AOI_SPOTS:
+                    if len(q.spotX) != len(q.spotZ):
+                        import grpc
+
+                        context.abort(
+                            grpc.StatusCode.INVALID_ARGUMENT,
+                            f"spotX/spotZ length mismatch "
+                            f"({len(q.spotX)} vs {len(q.spotZ)})",
+                        )
                     eng.set_spots_query(
                         q.connId, list(zip(q.spotX, q.spotZ)), list(q.spotDists)
                     )

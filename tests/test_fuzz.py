@@ -74,3 +74,59 @@ def test_connection_survives_hostile_packets():
         # Either the connection survived (unparseable packet dropped) or it
         # closed cleanly; both are acceptable, crashing is not.
         conn.close()
+
+
+def test_handlers_survive_hostile_field_values():
+    """Valid Packets whose MessagePacks carry wild-but-parseable field
+    values (huge channel ids, random broadcast bits, random bodies from
+    the right template) never raise through dispatch or the channel tick
+    (handler isolation, ref: channel.go tickMessages recover)."""
+    import asyncio
+
+    from channeld_tpu.core.channel import create_channel, get_channel
+    from channeld_tpu.core.message import init_message_map
+    from channeld_tpu.core.types import ChannelType
+    from channeld_tpu.protocol import MESSAGE_TEMPLATES, wire_pb2
+
+    init_message_map()
+    if get_channel(0) is None:
+        create_channel(ChannelType.GLOBAL, None)
+    rng = random.Random(11)
+    t = FakeTransport()
+    conn = add_connection(t, ConnectionType.CLIENT)
+
+    def wild_body(template_cls):
+        msg = template_cls()
+        for field in msg.DESCRIPTOR.fields:
+            if field.is_repeated:
+                continue
+            if field.type == field.TYPE_UINT32 and rng.random() < 0.7:
+                setattr(msg, field.name, rng.choice([0, 1, 0xFFFF, 0xFFFFFFFF]))
+            elif field.type == field.TYPE_STRING and rng.random() < 0.5:
+                setattr(msg, field.name, "x" * rng.randrange(0, 64))
+            elif field.type == field.TYPE_BOOL:
+                setattr(msg, field.name, rng.random() < 0.5)
+        return msg.SerializeToString()
+
+    for trial in range(200):
+        msg_type = rng.choice(list(MESSAGE_TEMPLATES))
+        mp = wire_pb2.MessagePack(
+            channelId=rng.choice([0, 1, 0x10000, 0x80000, 0xFFFFFFFF]),
+            broadcast=rng.randrange(0, 128),
+            stubId=rng.choice([0, 1, 0xFFFF]),
+            msgType=int(msg_type),
+            msgBody=wild_body(MESSAGE_TEMPLATES[msg_type]),
+        )
+        conn.receive_message(mp)  # drop or enqueue; never raise
+
+    # Handlers run inside the channel tick with per-message isolation.
+    gch = get_channel(0)
+
+    async def drain():
+        for i in range(8):
+            gch.tick_once(i * 10_000_000)
+
+    asyncio.run(drain())
+    # The runtime is still functional afterwards.
+    assert get_channel(0) is not None
+    conn.close()
