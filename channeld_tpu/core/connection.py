@@ -96,6 +96,7 @@ class QueuedMessagePackSender:
                 (int(ctx.channel_id), int(ctx.broadcast), int(ctx.stub_id),
                  int(ctx.msg_type), body)
             )
+            _pending_flush.add(conn)
 
 
 class Connection:
@@ -519,6 +520,20 @@ def all_connections() -> dict[int, Connection]:
     return _all_connections
 
 
+# Connections with queued output since the last pump cycle. The 1ms pump
+# drains this set instead of scanning every connection (the reference
+# pays one flush goroutine per connection instead; with thousands of
+# mostly-idle connections the scan is the asyncio analog's hot spot).
+_pending_flush: set["Connection"] = set()
+
+
+def drain_pending_flush() -> set["Connection"]:
+    """Hand the pending set to the pump and start a fresh one."""
+    global _pending_flush
+    pending, _pending_flush = _pending_flush, set()
+    return pending
+
+
 def flush_all() -> None:
     for conn in list(_all_connections.values()):
         if not conn.is_closing():
@@ -531,4 +546,5 @@ def reset_connections() -> None:
     for conn in list(_all_connections.values()):
         conn.state = ConnectionState.CLOSING
     _all_connections.clear()
+    _pending_flush.clear()
     _next_connection_id = 0

@@ -20,7 +20,12 @@ from typing import Optional
 from ..utils.logger import get_logger, init_logs
 from . import events
 from .channel import init_channels
-from .connection import Connection, add_connection, all_connections, init_connections
+from .connection import (
+    Connection,
+    add_connection,
+    drain_pending_flush,
+    init_connections,
+)
 from .connection_recovery import connection_recovery_loop
 from .ddos import init_anti_ddos, unauth_reaper_loop
 from .settings import global_settings
@@ -198,10 +203,12 @@ async def _reactor(conn: Connection, reader: asyncio.StreamReader) -> None:
 
 
 async def flush_loop(interval: float = 0.001) -> None:
-    """Shared send pump: batch + flush every connection's queue
-    (ref: the per-conn 1ms flush goroutine, connection.go:180-184)."""
+    """Shared send pump (ref: the per-conn 1ms flush goroutine,
+    connection.go:180-184). The 1ms cadence is the packet-coalescing
+    window; each cycle only visits connections that queued output since
+    the last one, so idle connections cost nothing."""
     while True:
-        for conn in list(all_connections().values()):
+        for conn in drain_pending_flush():
             if not conn.is_closing() and conn.send_queue:
                 conn.flush()
         await asyncio.sleep(interval)
