@@ -86,7 +86,11 @@ class ReplayClient:
         ]
         # Hooks (ref: Set*Handler): rewrite or veto outgoing packs.
         self.alter_channel_id: Optional[Callable] = None
-        self.before_send: dict[int, Callable] = {}
+        # msg_type -> (template_cls, handler(msg, msg_pack, client) -> bool);
+        # the recorded body is parsed into the template, the handler may
+        # mutate it in place (e.g. rewrite connId to the replayer's own id)
+        # or veto the send (ref: replay.go SetBeforeSendMessageEntry).
+        self.before_send: dict[int, tuple] = {}
         self.stats_lock = threading.Lock()
         self.packets_sent = 0
         self.messages_received = 0
@@ -164,7 +168,28 @@ class ReplayClient:
                             )
                         if not send_it:
                             continue
-                        client.send_raw(channel_id, mp.broadcast, mp.msgType, mp.msgBody)
+                        body = mp.msgBody
+                        entry = self.before_send.get(mp.msgType)
+                        if entry is not None:
+                            template_cls, handler = entry
+                            # A wrong template or corrupt recorded body must
+                            # not kill the connection's whole remaining run
+                            # (ref: replay.go:307-310 logs and skips).
+                            try:
+                                msg = template_cls()
+                                msg.ParseFromString(body)
+                                if not handler(msg, mp, client):
+                                    continue
+                                body = msg.SerializeToString()
+                            except Exception:
+                                # The hook exists because the recorded bytes
+                                # are wrong as-is — skip rather than send them.
+                                logger.exception(
+                                    "before_send hook failed for msgType %d; "
+                                    "skipping message", mp.msgType,
+                                )
+                                continue
+                        client.send_raw(channel_id, mp.broadcast, mp.msgType, body)
                         with self.stats_lock:
                             self.packets_sent += 1
                     client.tick()

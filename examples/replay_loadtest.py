@@ -1,0 +1,76 @@
+"""Replay load test: N connections replaying a recorded session.
+
+The reference's replay load-tester (pkg/replay/replay.go + examples/
+replay): each connection group replays a ``.cpr`` packet recording
+against a live gateway with staggered connects and recorded timing, and
+hooks rewrite messages per connection before sending — here the recorded
+subscription's connId becomes the replayer's own id, the same rewrite
+the reference's chat replay case does in its BeforeSendMessage handler.
+
+Run the gateway first:
+
+    python -m channeld_tpu -dev -cwm false \
+        -cfsm config/client_authoritative_fsm.json \
+        -imports channeld_tpu.models.chat \
+        -chs config/channel_settings_chat.json
+
+then:  python examples/replay_loadtest.py [case.json]
+
+The script claims GLOBAL first (initializing the chat data from the
+config's DataMsgFullName) so the replayed updates have a channel to
+land in — the role the chat-rooms master plays in the session's
+original recording context.
+"""
+
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from channeld_tpu.client import Client
+from channeld_tpu.core.types import BroadcastType, MessageType
+from channeld_tpu.protocol import control_pb2
+from channeld_tpu.replay.harness import ReplayClient
+
+
+def main() -> None:
+    case = sys.argv[1] if len(sys.argv) > 1 else "examples/replay_case.json"
+    rc = ReplayClient.from_config_file(case)
+
+    master = Client(rc.case_config.channeld_addr)
+    master.auth(pit="replay-master")
+    end = time.time() + 5
+    while master.id == 0 and time.time() < end:
+        master.tick(timeout=0.05)
+    assert master.id, "master auth failed"
+    master.send(0, BroadcastType.NO_BROADCAST, MessageType.CREATE_CHANNEL,
+                control_pb2.CreateChannelMessage(channelType=1))
+    try:
+        master.wait_for(MessageType.CREATE_CHANNEL, timeout=5)
+    except TimeoutError:
+        raise SystemExit("could not claim GLOBAL (is another master running?)")
+    stop = threading.Event()
+
+    def pump() -> None:
+        while not stop.is_set():
+            master.tick(timeout=0.05)
+
+    threading.Thread(target=pump, daemon=True).start()
+
+    def rewrite_sub(msg, mp, client) -> bool:
+        msg.connId = client.id  # each replayer subscribes itself
+        return True
+
+    rc.before_send[MessageType.SUB_TO_CHANNEL] = (
+        control_pb2.SubscribedToChannelMessage, rewrite_sub)
+
+    stats = rc.run()
+    stop.set()
+    print(f"replay done: {stats['packets_sent']} packets sent, "
+          f"{stats['messages_received']} fan-outs received")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,130 @@
+"""Replay load-test harness end-to-end over a real socket
+(ref: pkg/replay/replay.go — group replays a recorded session; the
+before-send entry rewrites messages per connection)."""
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+from channeld_tpu.core import connection as connection_mod
+from channeld_tpu.core.fsm import MessageFsm
+from channeld_tpu.core.server import flush_loop, start_listening
+from channeld_tpu.core.settings import global_settings
+from channeld_tpu.core.types import BroadcastType, ConnectionType, MessageType
+from channeld_tpu.models import testdata_pb2
+from channeld_tpu.protocol import control_pb2, wire_pb2
+from channeld_tpu.replay.harness import CaseConfig, ConnectionGroupConfig, ReplayClient
+from channeld_tpu.replay.session import ReplaySession
+from channeld_tpu.utils.anyutil import pack_any
+
+from helpers import fresh_runtime
+
+OPEN_FSM = {
+    "States": [{"Name": "OPEN", "MsgTypeWhitelist": "1-65535",
+                "MsgTypeBlacklist": ""}],
+    "Transitions": [],
+}
+
+
+@pytest.fixture(autouse=True)
+def runtime():
+    gch = fresh_runtime()
+    global_settings.development = True
+    connection_mod.set_fsm_templates(
+        MessageFsm.from_dict(OPEN_FSM), MessageFsm.from_dict(OPEN_FSM)
+    )
+    yield gch
+
+
+def build_session(tmp_path) -> str:
+    """Record: auth, sub (with a WRONG connId the hook must rewrite),
+    two data updates."""
+    s = ReplaySession()
+    auth = control_pb2.AuthMessage(playerIdentifierToken="rec", loginToken="lt")
+    sub = control_pb2.SubscribedToChannelMessage(
+        connId=424242,
+        subOptions=control_pb2.ChannelSubscriptionOptions(
+            dataAccess=2, fanOutIntervalMs=20),
+    )
+    upd = control_pb2.ChannelDataUpdateMessage(
+        data=pack_any(testdata_pb2.TestChannelDataMessage(text="replayed"))
+    )
+    for offset, msg_type, body in [
+        (0, MessageType.AUTH, auth.SerializeToString()),
+        (10_000_000, MessageType.SUB_TO_CHANNEL, sub.SerializeToString()),
+        (20_000_000, MessageType.CHANNEL_DATA_UPDATE, upd.SerializeToString()),
+        (30_000_000, MessageType.CHANNEL_DATA_UPDATE, upd.SerializeToString()),
+    ]:
+        packet = wire_pb2.Packet()
+        packet.messages.add(
+            channelId=0, broadcast=BroadcastType.NO_BROADCAST,
+            msgType=msg_type, msgBody=body,
+        )
+        s.proto.packets.add(offsetTime=offset, packet=packet)
+    path = str(tmp_path / "case.cpr")
+    with open(path, "wb") as f:
+        f.write(s.proto.SerializeToString())
+    return path
+
+
+def test_replay_harness_end_to_end(tmp_path):
+    from channeld_tpu.core.channel import get_global_channel
+
+    cpr = build_session(tmp_path)
+    port = 17293
+    loop = asyncio.new_event_loop()
+    stop = threading.Event()
+
+    async def gateway():
+        server = await start_listening(ConnectionType.CLIENT, "tcp", f":{port}")
+        flusher = asyncio.ensure_future(flush_loop())
+        gch = get_global_channel()
+        gch.init_data(testdata_pb2.TestChannelDataMessage(text="seed"), None)
+        try:
+            while not stop.is_set():
+                gch.tick_once(gch.get_time())
+                await asyncio.sleep(0.005)
+        finally:
+            flusher.cancel()
+            server.close()
+            await server.wait_closed()
+
+    def run():
+        try:
+            loop.run_until_complete(gateway())
+        finally:
+            loop.close()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    time.sleep(0.5)
+    try:
+        rc = ReplayClient(CaseConfig(
+            channeld_addr=f"127.0.0.1:{port}",
+            connection_groups=[ConnectionGroupConfig(
+                cpr_file_path=cpr, connection_number=3,
+                connect_interval=0.01, running_time=1.5,
+                action_interval_multiplier=1.0, wait_auth_success=True,
+                auth_only_once=True, sleep_end_of_session=0.05,
+            )],
+        ))
+        rewrote = []
+
+        def rewrite_sub(msg, mp, client):
+            assert msg.connId == 424242  # the recorded (wrong) id
+            msg.connId = client.id
+            rewrote.append(client.id)
+            return True
+
+        rc.before_send[MessageType.SUB_TO_CHANNEL] = (
+            control_pb2.SubscribedToChannelMessage, rewrite_sub)
+        stats = rc.run()
+    finally:
+        stop.set()
+        t.join(timeout=5)
+
+    assert stats["packets_sent"] >= 9  # 3 conns x (auth + sub + 2 upd) - dups
+    assert stats["messages_received"] > 0  # fan-outs made it back
+    assert len(set(rewrote)) == 3  # every connection got its own rewrite
