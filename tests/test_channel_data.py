@@ -275,3 +275,44 @@ def test_skip_first_fanout():
     assert len(sub.data_updates()) == 1
     assert sub.latest_data_update().text == "post"
     assert sub.latest_data_update().num == 0  # never saw the initial state
+
+
+def test_merge_sub_options_on_resubscribe():
+    """Re-subscribing merges partial options over the existing ones:
+    explicitly-sent fields override, unsent fields keep their values, and
+    the result-send flag fires only when data access changed
+    (ref: data_test.go TestMergeSubOptions + subscription.go:34-102)."""
+    conn = StubConnection(1)
+    ch = create_channel(ChannelType.TEST, None)
+    cs, _ = subscribe_to_channel(
+        conn, ch, control_pb2.ChannelSubscriptionOptions(
+            dataAccess=2,  # WRITE
+            fanOutIntervalMs=100, fanOutDelayMs=200),
+    )
+    assert (cs.options.dataAccess, cs.options.fanOutIntervalMs,
+            cs.options.fanOutDelayMs) == (2, 100, 200)
+
+    # Partial update: access drops to READ, interval halves, delay unsent.
+    cs2, access_changed = subscribe_to_channel(
+        conn, ch, control_pb2.ChannelSubscriptionOptions(
+            dataAccess=1, fanOutIntervalMs=50),
+    )
+    assert cs2 is cs and access_changed
+    assert (cs.options.dataAccess, cs.options.fanOutIntervalMs,
+            cs.options.fanOutDelayMs) == (1, 50, 200)
+
+    # Non-access field changed: merged, but no result resend needed.
+    _, access_changed = subscribe_to_channel(
+        conn, ch, control_pb2.ChannelSubscriptionOptions(fanOutIntervalMs=20),
+    )
+    assert not access_changed
+    assert cs.options.fanOutIntervalMs == 20
+
+    # Identical options resent: no change, no result resend.
+    _, access_changed = subscribe_to_channel(
+        conn, ch, control_pb2.ChannelSubscriptionOptions(
+            dataAccess=1, fanOutIntervalMs=20),
+    )
+    assert not access_changed
+    assert (cs.options.dataAccess, cs.options.fanOutIntervalMs,
+            cs.options.fanOutDelayMs) == (1, 20, 200)
