@@ -209,11 +209,6 @@ def tick_data(channel: "Channel", now: int) -> None:
             continue
 
         latest_fanout_time = next_fanout_time
-        if data.accumulated_update_msg is None:
-            data.accumulated_update_msg = type(data.msg)()
-        else:
-            data.accumulated_update_msg.Clear()
-        has_ever_merged = False
 
         if not foc.had_first_fanout:
             # First fan-out carries the full channel state.
@@ -227,21 +222,36 @@ def tick_data(channel: "Channel", now: int) -> None:
             last_update_time = max(foc.last_fanout_time, 0)
             lo = bisect_left(arrivals, last_update_time)
             hi = bisect_right(arrivals, next_fanout_time)
-            for be in data.update_msg_buffer[lo:hi]:
-                if be.sender_conn_id == conn.id and cs.options.skipSelfUpdateFanOut:
-                    continue
-                if not has_ever_merged:
-                    data.accumulated_update_msg.MergeFrom(be.update_msg)
+            window = [
+                be for be in data.update_msg_buffer[lo:hi]
+                if not (be.sender_conn_id == conn.id
+                        and cs.options.skipSelfUpdateFanOut)
+            ]
+            if len(window) == 1:
+                # The common case (one update per window) needs no
+                # accumulator: the reference's first merge is a plain
+                # proto.Merge into a cleared message — an exact copy —
+                # so the buffered update fans out directly
+                # (fan_out_data_update never mutates its argument).
+                foc.last_message_index = window[0].message_index
+                fan_out_data_update(channel, conn, cs, window[0].update_msg)
+            elif window:
+                if data.accumulated_update_msg is None:
+                    data.accumulated_update_msg = type(data.msg)()
                 else:
+                    data.accumulated_update_msg.Clear()
+                # First merge into the cleared accumulator is a plain copy;
+                # merge options apply from the second on (ref: data.go
+                # hasEverMerged).
+                data.accumulated_update_msg.MergeFrom(window[0].update_msg)
+                for be in window[1:]:
                     merge_with_options(
                         data.accumulated_update_msg,
                         be.update_msg,
                         data.merge_options,
                         None,
                     )
-                has_ever_merged = True
-                foc.last_message_index = be.message_index
-            if has_ever_merged:
+                foc.last_message_index = window[-1].message_index
                 fan_out_data_update(channel, conn, cs, data.accumulated_update_msg)
 
         foc.last_fanout_time = latest_fanout_time
