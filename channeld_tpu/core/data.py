@@ -171,6 +171,25 @@ class ChannelData:
                 self.update_msg_buffer.pop(0)
 
 
+def _accumulate_window(data: "ChannelData", window: list, fresh: bool = False):
+    """Merge a window of buffered updates: first entry is a plain copy,
+    the rest merge with options (ref: data.go hasEverMerged). ``fresh``
+    returns a new message (safe to cache); otherwise the channel's
+    scratch accumulator is reused (consume before the next call)."""
+    if fresh:
+        acc = type(data.msg)()
+    else:
+        if data.accumulated_update_msg is None:
+            data.accumulated_update_msg = type(data.msg)()
+        else:
+            data.accumulated_update_msg.Clear()
+        acc = data.accumulated_update_msg
+    acc.MergeFrom(window[0].update_msg)
+    for be in window[1:]:
+        merge_with_options(acc, be.update_msg, data.merge_options, None)
+    return acc
+
+
 def tick_data(channel: "Channel", now: int) -> None:
     """The per-tick fan-out decision + send loop (ref: data.go:175-291).
 
@@ -188,6 +207,11 @@ def tick_data(channel: "Channel", now: int) -> None:
     # ring per subscriber. Built lazily: ticks with no due subscriber pay
     # nothing.
     arrivals = None
+    # Subscribers sharing the same window slice get the same accumulated
+    # message unless skip-self excludes one of their own updates from it:
+    # (lo, hi) -> [sender_id_set, merged_msg_or_None]. Scoped to this
+    # tick; fan_out_data_update never mutates what it sends.
+    shared_windows: dict = {}
 
     queue = channel.fan_out_queue
     for foc in list(queue):
@@ -222,37 +246,40 @@ def tick_data(channel: "Channel", now: int) -> None:
             last_update_time = max(foc.last_fanout_time, 0)
             lo = bisect_left(arrivals, last_update_time)
             hi = bisect_right(arrivals, next_fanout_time)
-            window = [
-                be for be in data.update_msg_buffer[lo:hi]
-                if not (be.sender_conn_id == conn.id
-                        and cs.options.skipSelfUpdateFanOut)
-            ]
-            if len(window) == 1:
-                # The common case (one update per window) needs no
-                # accumulator: the reference's first merge is a plain
-                # proto.Merge into a cleared message — an exact copy —
-                # so the buffered update fans out directly
-                # (fan_out_data_update never mutates its argument).
-                foc.last_message_index = window[0].message_index
-                fan_out_data_update(channel, conn, cs, window[0].update_msg)
-            elif window:
-                if data.accumulated_update_msg is None:
-                    data.accumulated_update_msg = type(data.msg)()
-                else:
-                    data.accumulated_update_msg.Clear()
-                # First merge into the cleared accumulator is a plain copy;
-                # merge options apply from the second on (ref: data.go
-                # hasEverMerged).
-                data.accumulated_update_msg.MergeFrom(window[0].update_msg)
-                for be in window[1:]:
-                    merge_with_options(
-                        data.accumulated_update_msg,
-                        be.update_msg,
-                        data.merge_options,
-                        None,
+            entry = shared_windows.get((lo, hi))
+            if entry is None:
+                entry = shared_windows[(lo, hi)] = [
+                    {be.sender_conn_id for be in data.update_msg_buffer[lo:hi]},
+                    None,
+                ]
+            if cs.options.skipSelfUpdateFanOut and conn.id in entry[0]:
+                # This subscriber's own update is in the slice: accumulate
+                # its personal window with the self-updates excluded.
+                window = [
+                    be for be in data.update_msg_buffer[lo:hi]
+                    if be.sender_conn_id != conn.id
+                ]
+                if window:
+                    foc.last_message_index = window[-1].message_index
+                    fan_out_data_update(
+                        channel, conn, cs,
+                        window[0].update_msg if len(window) == 1
+                        else _accumulate_window(data, window),
                     )
-                foc.last_message_index = window[-1].message_index
-                fan_out_data_update(channel, conn, cs, data.accumulated_update_msg)
+            elif hi > lo:
+                # Shared path: merge the slice once, reuse for every
+                # subscriber with this exact window. The cached message
+                # outlives this iteration, so it gets its own object
+                # rather than the per-sub scratch accumulator.
+                if entry[1] is None:
+                    window = data.update_msg_buffer[lo:hi]
+                    entry[1] = (
+                        window[0].update_msg
+                        if len(window) == 1
+                        else _accumulate_window(data, window, fresh=True)
+                    )
+                foc.last_message_index = data.update_msg_buffer[hi - 1].message_index
+                fan_out_data_update(channel, conn, cs, entry[1])
 
         foc.last_fanout_time = latest_fanout_time
 
