@@ -45,7 +45,10 @@ def run_sim_clients(n, behavior, duration, addr="127.0.0.1:12108"):
     out = subprocess.run(
         [sys.executable, "examples/sim_clients.py", "--addr", addr,
          "-n", str(n), "--behavior", behavior, "--duration", str(duration)],
-        cwd=REPO, capture_output=True, text=True, timeout=duration + 60,
+        cwd=REPO, capture_output=True, text=True,
+        # 2000 GIL-bound client threads need tens of seconds just to
+        # connect and wind down; scale the guard with the fleet size.
+        timeout=duration + 60 + n * 0.06,
     )
     line = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else ""
     sent = received = 0
