@@ -1,8 +1,12 @@
 """Profiling hooks (ref: pkg/channeld/profiling.go:12-31).
 
-``-profile cpu`` -> cProfile, ``-profile mem`` -> tracemalloc; results are
-written to the profile path on shutdown, with a signal-safe stop on
-SIGINT/SIGTERM like the reference's pkg/profile integration.
+``-profile cpu`` -> cProfile, ``-profile mem`` -> tracemalloc,
+``-profile tpu`` -> a jax profiler trace (XLA ops, device timelines,
+HLO — viewable in TensorBoard or Perfetto). Results are written to the
+profile path on shutdown, with a signal-safe stop on SIGINT/SIGTERM
+like the reference's pkg/profile integration. The reference's
+"goroutine" mode has no analog here; the runtime is a single asyncio
+loop plus the device stream the tpu trace covers.
 """
 
 from __future__ import annotations
@@ -19,12 +23,13 @@ logger = get_logger("profiling")
 
 _cpu_profiler = None
 _mem_tracing = False
+_tpu_trace_dir: Optional[str] = None
 _profile_path = "profiles"
 
 
 def start_profiling(kind: str, profile_path: str = "profiles") -> None:
-    """(ref: StartProfiling). kind in {"", "cpu", "mem"}."""
-    global _cpu_profiler, _mem_tracing, _profile_path
+    """(ref: StartProfiling). kind in {"", "cpu", "mem", "tpu"}."""
+    global _cpu_profiler, _mem_tracing, _tpu_trace_dir, _profile_path
     if not kind:
         return
     _profile_path = profile_path
@@ -41,6 +46,12 @@ def start_profiling(kind: str, profile_path: str = "profiles") -> None:
         tracemalloc.start()
         _mem_tracing = True
         logger.info("memory profiling started")
+    elif kind == "tpu":
+        import jax
+
+        _tpu_trace_dir = os.path.join(profile_path, "tpu_trace")
+        jax.profiler.start_trace(_tpu_trace_dir)
+        logger.info("device trace started -> %s", _tpu_trace_dir)
     else:
         raise ValueError(f"invalid profile type: {kind}")
 
@@ -53,8 +64,15 @@ def start_profiling(kind: str, profile_path: str = "profiles") -> None:
 
 
 def stop_profiling() -> Optional[str]:
-    global _cpu_profiler, _mem_tracing
+    global _cpu_profiler, _mem_tracing, _tpu_trace_dir
     stamp = time.strftime("%Y%m%d%H%M%S")
+    if _tpu_trace_dir is not None:
+        import jax
+
+        jax.profiler.stop_trace()
+        path, _tpu_trace_dir = _tpu_trace_dir, None
+        logger.info("device trace written to %s", path)
+        return path
     if _cpu_profiler is not None:
         path = os.path.join(_profile_path, f"cpu_{stamp}.pstats")
         _cpu_profiler.disable()

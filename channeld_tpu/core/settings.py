@@ -150,7 +150,7 @@ class GlobalSettings:
                        help="-1 Debug, 0 Info, 1 Warn, 2 Error")
         p.add_argument("-logfile", type=str, default=None)
         p.add_argument("-profile", type=str, default="",
-                       help="cpu | mem (wall profiling of the process)")
+                       help="cpu | mem | tpu (process profile or device trace)")
         p.add_argument("-profilepath", type=str, default=self.profile_path)
         p.add_argument("-sn", type=str, default=self.server_network,
                        help="server network type: tcp | ws")

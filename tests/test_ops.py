@@ -389,3 +389,24 @@ def test_engine_spots_incremental_row_update():
     assert not eng._spot_dirty_rows
     # Second query triggers the lazy-attach only once.
     assert eng._d_spot_dist is not before  # scatter produced a new buffer
+
+
+def test_tpu_profile_trace(tmp_path):
+    """-profile tpu writes a jax device trace (xplane + perfetto json)
+    viewable in TensorBoard (ref: profiling.go StartProfiling; the tpu
+    mode is the device-plane analog of the reference's pprof modes)."""
+    import os
+
+    from channeld_tpu.core.profiling import start_profiling, stop_profiling
+
+    start_profiling("tpu", str(tmp_path))
+    try:
+        eng = SpatialEngine(GRID, entity_capacity=16, query_capacity=8,
+                            sub_capacity=8, max_handovers=8)
+        eng.add_entity(1, 0, 0, 0)
+        eng.tick(now_ms=0)
+    finally:
+        path = stop_profiling()
+    assert path is not None
+    found = [f for root, _, files in os.walk(path) for f in files]
+    assert any("xplane" in f or "trace" in f for f in found), found
