@@ -85,7 +85,7 @@ class QueuedMessagePackSender:
     encoder consumes them without protobuf object churn."""
 
     def send(self, conn: "Connection", ctx) -> None:
-        body = ctx.msg.SerializeToString()
+        body = ctx.raw_body if ctx.raw_body is not None else ctx.msg.SerializeToString()
         if _pack_size(ctx, len(body)) >= MAX_PACKET_SIZE - HEADER_SIZE:
             conn.logger.warning(
                 "message dropped: size %d exceeds packet limit", len(body)

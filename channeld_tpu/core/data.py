@@ -212,6 +212,7 @@ def tick_data(channel: "Channel", now: int) -> None:
     # (lo, hi) -> [sender_id_set, merged_msg_or_None]. Scoped to this
     # tick; fan_out_data_update never mutates what it sends.
     shared_windows: dict = {}
+    body_cache: dict = {}  # id(update_msg) -> (msg ref, bytes, wrapper)
 
     queue = channel.fan_out_queue
     for foc in list(queue):
@@ -236,7 +237,7 @@ def tick_data(channel: "Channel", now: int) -> None:
 
         if not foc.had_first_fanout:
             # First fan-out carries the full channel state.
-            fan_out_data_update(channel, conn, cs, data.msg)
+            fan_out_data_update(channel, conn, cs, data.msg, body_cache)
             foc.had_first_fanout = True
             foc.last_message_index = data.msg_index
             latest_fanout_time = now
@@ -261,11 +262,18 @@ def tick_data(channel: "Channel", now: int) -> None:
                 ]
                 if window:
                     foc.last_message_index = window[-1].message_index
-                    fan_out_data_update(
-                        channel, conn, cs,
-                        window[0].update_msg if len(window) == 1
-                        else _accumulate_window(data, window),
-                    )
+                    if len(window) == 1:
+                        # A single foreign update is a stable buffered
+                        # message — cache-safe like the shared path.
+                        fan_out_data_update(
+                            channel, conn, cs, window[0].update_msg, body_cache
+                        )
+                    else:
+                        # The scratch accumulator is reused next call; its
+                        # bytes must not enter the shared cache.
+                        fan_out_data_update(
+                            channel, conn, cs, _accumulate_window(data, window)
+                        )
             elif hi > lo:
                 # Shared path: merge the slice once, reuse for every
                 # subscriber with this exact window. The cached message
@@ -279,7 +287,7 @@ def tick_data(channel: "Channel", now: int) -> None:
                         else _accumulate_window(data, window, fresh=True)
                     )
                 foc.last_message_index = data.update_msg_buffer[hi - 1].message_index
-                fan_out_data_update(channel, conn, cs, entry[1])
+                fan_out_data_update(channel, conn, cs, entry[1], body_cache)
 
         foc.last_fanout_time = latest_fanout_time
 
@@ -289,18 +297,38 @@ def tick_data(channel: "Channel", now: int) -> None:
     queue.sort(key=lambda f: f.last_fanout_time)
 
 
-def fan_out_data_update(channel: "Channel", conn, cs, update_msg: Message) -> None:
-    """(ref: data.go:293-318)."""
+def fan_out_data_update(
+    channel: "Channel", conn, cs, update_msg: Message,
+    body_cache: Optional[dict] = None,
+) -> None:
+    """(ref: data.go:293-318).
+
+    ``body_cache`` (tick-scoped) shares the serialized update across
+    subscribers receiving the identical message: a broadcast channel
+    encodes each window once, not once per recipient. Values hold the
+    source message alongside the bytes so an ``id()`` key can't be
+    recycled mid-tick.
+    """
     if cs.options.dataFieldMasks:
         update_msg = _filtered_copy(update_msg, list(cs.options.dataFieldMasks))
+        body_cache = None  # per-subscriber content
     from .message import MessageContext  # local: message imports data
 
+    hit = body_cache.get(id(update_msg)) if body_cache is not None else None
+    if hit is not None:
+        _, raw, msg = hit
+    else:
+        msg = control_pb2.ChannelDataUpdateMessage(data=pack_any(update_msg))
+        raw = msg.SerializeToString()
+        if body_cache is not None:
+            body_cache[id(update_msg)] = (update_msg, raw, msg)
     conn.send(
         MessageContext(
             msg_type=MessageType.CHANNEL_DATA_UPDATE,
-            msg=control_pb2.ChannelDataUpdateMessage(data=pack_any(update_msg)),
+            msg=msg,
             channel=channel,
             channel_id=channel.id,
+            raw_body=raw,
         )
     )
 

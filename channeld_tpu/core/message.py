@@ -49,6 +49,17 @@ class MessageContext:
     connection: Optional[object] = None  # receiving connection
     channel: Optional["Channel"] = None
     arrival_time: float = 0.0
+    # Pre-serialized ``msg`` bytes: senders use these instead of
+    # re-serializing, letting a broadcast share one encode across all
+    # recipients. Reassigning ``msg`` invalidates them (enforced below).
+    raw_body: Optional[bytes] = None
+
+    def __setattr__(self, name: str, value) -> None:
+        # Keep raw_body honest: swapping the message (the forwarding
+        # handlers' pattern) must never ship the old bytes.
+        if name == "msg" and getattr(self, "raw_body", None) is not None:
+            object.__setattr__(self, "raw_body", None)
+        object.__setattr__(self, name, value)
 
     def has_connection(self) -> bool:
         return self.connection is not None and not self.connection.is_closing()
