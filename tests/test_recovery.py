@@ -211,3 +211,71 @@ def test_client_messages_dropped_while_owner_recovering():
     server2.flush()
     # Dropped: the recovering owner got no forwarded user-space message.
     assert [m for m in sent_types(t3) if m.msgType == 100] == []
+
+
+def test_spatial_server_recovery_restores_block_ownership():
+    """A spatial server crashing unexpectedly loses its grid slot on the
+    controller tick (spatial.go:884-893 reaps unconditionally), but its
+    channel OWNERSHIP is restored through the recovery machinery on PIT
+    re-auth — the combination the reference relies on for seamless
+    spatial server restarts."""
+    from channeld_tpu.spatial.grid import StaticGrid2DSpatialController
+    from channeld_tpu.core.message import MessageContext
+
+    gch = get_global_channel()
+    ctl = StaticGrid2DSpatialController()
+    ctl.load_config(dict(
+        WorldOffsetX=-100, WorldOffsetZ=-100, GridWidth=100, GridHeight=100,
+        GridCols=2, GridRows=2, ServerCols=2, ServerRows=2,
+        ServerInterestBorderSize=1,
+    ))
+
+    t1 = FakeTransport()
+    server = add_connection(t1, ConnectionType.SERVER)
+    server.on_bytes(
+        wire(MessageType.AUTH, control_pb2.AuthMessage(playerIdentifierToken="sp1"))
+    )
+    gch.tick_once(0)
+    channels = ctl.create_channels(MessageContext(
+        msg_type=MessageType.CREATE_CHANNEL,
+        msg=control_pb2.CreateChannelMessage(),
+        connection=server,
+    ))
+    assert len(channels) == 1
+    sp_ch = channels[0]
+    sp_ch.init_data(testdata_pb2.TestChannelDataMessage(text="cell", num=4), None)
+    subscribe_to_channel(server, sp_ch, None)
+    old_conn_id = server.id
+
+    server.close(unexpected=True)
+    assert server.recover_handle is not None
+    sp_ch.tick_once(sp_ch.get_time())  # stash the recoverable sub
+    ctl.tick()
+    # The grid slot frees immediately (a fresh server could claim it).
+    assert ctl.server_connections[0] is None
+
+    # Same PIT re-authenticates within the window: conn id reclaimed...
+    t2 = FakeTransport()
+    reborn = add_connection(t2, ConnectionType.SERVER)
+    reborn.on_bytes(
+        wire(MessageType.AUTH, control_pb2.AuthMessage(playerIdentifierToken="sp1"))
+    )
+    gch.tick_once(0)
+    assert reborn.id == old_conn_id
+    # ...and the spatial channel's ownership + subscription return on the
+    # channel tick.
+    sp_ch.tick_once(sp_ch.get_time())
+    assert sp_ch.get_owner() is reborn
+    assert reborn in sp_ch.subscribed_connections
+
+    # The spatial channel's state streams back as RECOVERY_CHANNEL_DATA.
+    reborn.flush()
+    rec = [m for m in sent_types(t2)
+           if m.msgType == MessageType.RECOVERY_CHANNEL_DATA]
+    assert len(rec) == 1
+    rmsg = control_pb2.ChannelDataRecoveryMessage()
+    rmsg.ParseFromString(rec[0].msgBody)
+    assert rmsg.channelId == sp_ch.id and rmsg.ownerConnId == reborn.id
+    data = testdata_pb2.TestChannelDataMessage()
+    rmsg.channelData.Unpack(data)
+    assert data.text == "cell" and data.num == 4
