@@ -449,6 +449,10 @@ class Channel:
     def broadcast(self, ctx) -> None:
         """(ref: channel.go:495-520)."""
         bc = BroadcastType(ctx.broadcast)
+        # One encode for the whole fleet (every recipient gets the same
+        # bytes; the queued sender honors ctx.raw_body).
+        if ctx.raw_body is None and ctx.msg is not None:
+            ctx.raw_body = ctx.msg.SerializeToString()
         for conn in list(self.subscribed_connections.keys()):
             if conn is None:
                 continue

@@ -170,6 +170,9 @@ def _broadcast_adjacent(ctx: MessageContext, msg) -> None:
         if ch is None:
             continue
         conns |= ch.get_all_connections()
+    # One encode for the whole adjacent fleet (see Channel.broadcast).
+    if ctx.raw_body is None and ctx.msg is not None:
+        ctx.raw_body = ctx.msg.SerializeToString()
     for conn in conns:
         if bc.check(BroadcastType.ALL_BUT_SENDER) and conn is ctx.connection:
             continue
