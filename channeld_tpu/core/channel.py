@@ -174,9 +174,10 @@ class Channel:
 
     # ---- message queue ---------------------------------------------------
 
-    def put_message(self, msg, handler, conn, pack) -> None:
+    def put_message(self, msg, handler, conn, pack, raw_body=None) -> None:
         """Enqueue from any task; handled in this channel's tick
-        (ref: channel.go:295-310)."""
+        (ref: channel.go:295-310). ``raw_body`` carries the inbound bytes
+        through for pure forwards so the send side need not re-encode."""
         if self.is_removing():
             return
         from .message import MessageContext
@@ -190,6 +191,7 @@ class Channel:
             stub_id=pack.stubId,
             channel_id=pack.channelId,
             arrival_time=self.get_time(),
+            raw_body=raw_body,
         )
         self._enqueue(_QueuedMessage(ctx, handler))
 
@@ -451,8 +453,7 @@ class Channel:
         bc = BroadcastType(ctx.broadcast)
         # One encode for the whole fleet (every recipient gets the same
         # bytes; the queued sender honors ctx.raw_body).
-        if ctx.raw_body is None and ctx.msg is not None:
-            ctx.raw_body = ctx.msg.SerializeToString()
+        ctx.ensure_raw_body()
         for conn in list(self.subscribed_connections.keys()):
             if conn is None:
                 continue

@@ -202,6 +202,7 @@ class Connection:
                 )
             return False
 
+        raw_body = None
         entry = MESSAGE_MAP.get(mp.msgType)
         if entry is None and mp.msgType < MessageType.USER_SPACE_START:
             self.logger.error("undefined message type %d", mp.msgType)
@@ -233,6 +234,10 @@ class Connection:
                     self.logger.exception("unmarshalling ServerForwardMessage")
                     return False
                 handler = handle_server_to_client_user_message
+                # Pure forward (no registered handler exists for this type,
+                # so nothing mutates the message): the inbound bytes ARE
+                # the outbound bytes — skip the re-encode entirely.
+                raw_body = mp.msgBody
         else:
             tmpl = entry.template
             # Registry entries may hold the class or a prototype instance;
@@ -248,7 +253,7 @@ class Connection:
         if self.fsm is not None:
             self.fsm.on_received(mp.msgType)
 
-        channel.put_message(msg, handler, self, mp)
+        channel.put_message(msg, handler, self, mp, raw_body=raw_body)
         key = (channel.channel_type, mp.msgType)
         child = self._m_msg_received.get(key)
         if child is None:

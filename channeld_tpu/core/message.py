@@ -61,6 +61,12 @@ class MessageContext:
             object.__setattr__(self, "raw_body", None)
         object.__setattr__(self, name, value)
 
+    def ensure_raw_body(self) -> None:
+        """Encode once before a multi-recipient send; lives next to the
+        invalidation guard so the contract stays in one place."""
+        if self.raw_body is None and self.msg is not None:
+            self.raw_body = self.msg.SerializeToString()
+
     def has_connection(self) -> bool:
         return self.connection is not None and not self.connection.is_closing()
 
@@ -171,8 +177,7 @@ def _broadcast_adjacent(ctx: MessageContext, msg) -> None:
             continue
         conns |= ch.get_all_connections()
     # One encode for the whole adjacent fleet (see Channel.broadcast).
-    if ctx.raw_body is None and ctx.msg is not None:
-        ctx.raw_body = ctx.msg.SerializeToString()
+    ctx.ensure_raw_body()
     for conn in conns:
         if bc.check(BroadcastType.ALL_BUT_SENDER) and conn is ctx.connection:
             continue

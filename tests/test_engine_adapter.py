@@ -138,3 +138,48 @@ def test_check_entity_handover():
     # UE axis swap: Z-up -> Y-up.
     moved, old, new = check_entity_handover(1, b2, a, swap_yz=True)
     assert new.y == 3 and new.z == 2
+
+
+def test_well_known_entity_visible_to_all_clients(runtime):
+    """isWellKnown entity channels subscribe every current client at
+    creation and every later-authenticating client via the auth hook with
+    a 1s fan-out delay (ref: message_spatial.go:191-333 well-known
+    entities + Event_AuthComplete)."""
+    from channeld_tpu.core import events
+    from channeld_tpu.core.channel import get_global_channel
+    from channeld_tpu.core.connection import add_connection
+    from channeld_tpu.spatial.messages import handle_create_entity_channel
+    from channeld_tpu.protocol import spatial_pb2
+
+    from helpers import FakeTransport
+
+    server = StubConnection(1, ConnectionType.SERVER)
+    early_client = add_connection(FakeTransport(), ConnectionType.CLIENT)
+
+    ctx = MessageContext(
+        msg_type=MessageType.CREATE_ENTITY_CHANNEL,
+        msg=spatial_pb2.CreateEntityChannelMessage(entityId=E + 777, isWellKnown=True),
+        connection=server,
+        channel=get_global_channel(),
+        channel_id=0,
+    )
+    handle_create_entity_channel(ctx)
+    ch = get_channel(E + 777)
+    assert ch is not None
+    assert early_client in ch.subscribed_connections  # existing client
+
+    # A client authenticating later is auto-subscribed with the spawn
+    # grace delay.
+    late_client = add_connection(FakeTransport(), ConnectionType.CLIENT)
+    events.auth_complete.broadcast(
+        events.AuthEventData(connection=late_client, player_identifier_token="late")
+    )
+    assert late_client in ch.subscribed_connections
+    assert ch.subscribed_connections[late_client].options.fanOutDelayMs == 1000
+
+    # Another server is NOT swept in.
+    other_server = StubConnection(9, ConnectionType.SERVER)
+    events.auth_complete.broadcast(
+        events.AuthEventData(connection=other_server, player_identifier_token="srv")
+    )
+    assert other_server not in ch.subscribed_connections
