@@ -212,7 +212,7 @@ def tick_data(channel: "Channel", now: int) -> None:
     # (lo, hi) -> [sender_id_set, merged_msg_or_None]. Scoped to this
     # tick; fan_out_data_update never mutates what it sends.
     shared_windows: dict = {}
-    body_cache: dict = {}  # id(update_msg) -> (msg ref, bytes, wrapper)
+    body_cache: dict = {}  # id(update_msg) -> (msg ref, shared MessageContext)
 
     queue = channel.fan_out_queue
     for foc in list(queue):
@@ -316,21 +316,20 @@ def fan_out_data_update(
 
     hit = body_cache.get(id(update_msg)) if body_cache is not None else None
     if hit is not None:
-        _, raw, msg = hit
-    else:
-        msg = control_pb2.ChannelDataUpdateMessage(data=pack_any(update_msg))
-        raw = msg.SerializeToString()
-        if body_cache is not None:
-            body_cache[id(update_msg)] = (update_msg, raw, msg)
-    conn.send(
-        MessageContext(
-            msg_type=MessageType.CHANNEL_DATA_UPDATE,
-            msg=msg,
-            channel=channel,
-            channel_id=channel.id,
-            raw_body=raw,
-        )
+        conn.send(hit[1])
+        return
+    ctx = MessageContext(
+        msg_type=MessageType.CHANNEL_DATA_UPDATE,
+        msg=control_pb2.ChannelDataUpdateMessage(data=pack_any(update_msg)),
+        channel=channel,
+        channel_id=channel.id,
     )
+    ctx.ensure_raw_body()
+    if body_cache is not None:
+        # The queued sender consumes the context immediately (tuple into
+        # the send queue), so one context object serves every recipient.
+        body_cache[id(update_msg)] = (update_msg, ctx)
+    conn.send(ctx)
 
 
 def _filtered_copy(msg: Message, masks: list[str]) -> Message:
