@@ -1,0 +1,87 @@
+"""Host-plane fan-out microbenchmarks (single thread, no sockets).
+
+Measures the per-tick cost of the ChannelData fan-out decision + send
+path at high subscriber counts — the host-side complement of bench.py's
+device decision plane. Run from the repo root:
+
+    python scripts/bench_host.py [--subs 1000] [--ticks 200]
+
+Prints one JSON line per scenario.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"))
+
+from helpers import StubConnection, fresh_runtime  # noqa: E402
+
+from channeld_tpu.core.channel import create_channel  # noqa: E402
+from channeld_tpu.core.data import tick_data  # noqa: E402
+from channeld_tpu.core.subscription import subscribe_to_channel  # noqa: E402
+from channeld_tpu.core.types import ChannelType, MessageType  # noqa: E402
+from channeld_tpu.models import testdata_pb2  # noqa: E402
+from channeld_tpu.protocol import control_pb2  # noqa: E402
+
+MS = 1_000_000
+
+
+def run_scenario(name: str, n_subs: int, ticks: int, updates_per_window: int):
+    fresh_runtime()
+    ch = create_channel(ChannelType.TEST, None)
+    ch.init_data(testdata_pb2.TestChannelDataMessage(text="x"), None)
+    conns = [StubConnection(i + 10) for i in range(n_subs)]
+    for c in conns:
+        subscribe_to_channel(
+            c, ch, control_pb2.ChannelSubscriptionOptions(fanOutIntervalMs=50)
+        )
+    # Warm-up past every subscription's first due time (sub_time is the
+    # real channel clock, so 50ms exactly would still be before it).
+    tick_data(ch, 100 * MS)
+    assert all(len(c.sent) == 1 for c in conns), "warm-up must flush first fan-outs"
+    t0 = time.perf_counter()
+    for i in range(1, ticks + 1):
+        for k in range(updates_per_window):
+            # Sender id 1 is not a subscriber: measures the pure shared
+            # fan-out path (skip-self defaults on; subscriber senders
+            # would divert windows onto the personal path).
+            ch.data.on_update(
+                testdata_pb2.TestChannelDataMessage(text=f"u{i}-{k}"),
+                (100 + i * 50 + k) * MS,
+                1,
+                None,
+            )
+        tick_data(ch, (150 + i * 50) * MS)
+    dt = time.perf_counter() - t0
+    total = sum(
+        sum(1 for ctx in c.sent if ctx.msg_type == MessageType.CHANNEL_DATA_UPDATE)
+        for c in conns
+    ) - n_subs  # exclude the warm-up full-state sends
+    return {
+        "scenario": name,
+        "subs": n_subs,
+        "updates_per_window": updates_per_window,
+        "ms_per_tick": round(dt / ticks * 1000, 2),
+        "fanouts_per_sec": round(total / dt),
+    }
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--subs", type=int, default=1000)
+    p.add_argument("--ticks", type=int, default=200)
+    args = p.parse_args()
+    for name, upw in (("single-update-window", 1), ("six-update-window", 6)):
+        print(json.dumps(run_scenario(name, args.subs, args.ticks, upw)),
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()
