@@ -195,3 +195,35 @@ def test_client_stub_rpc_callback():
         assert len(replies) == 1
 
     run_gateway_and_client("tcp", 23192, "127.0.0.1:23192", body=body)
+
+
+def test_rudp_survives_hostile_datagrams():
+    """Random garbage datagrams at the rudp port (wrong magic, truncated
+    headers, huge bodies) are dropped without wedging the listener: a
+    real client still completes auth afterwards."""
+    import random
+    import socket
+
+    port = 23193
+
+    def body(client):
+        rng = random.Random(5)
+        raw = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            for _ in range(200):
+                n = rng.randrange(0, 64)
+                raw.sendto(bytes(rng.randrange(256) for _ in range(n)),
+                           ("127.0.0.1", port))
+            raw.sendto(b"\xff" * 2000, ("127.0.0.1", port))
+        finally:
+            raw.close()
+        # The listener still serves the legit client after the garbage.
+        from channeld_tpu.core.types import MessageType
+        from channeld_tpu.protocol import control_pb2
+
+        client.send(0, 0, MessageType.LIST_CHANNEL,
+                    control_pb2.ListChannelMessage())
+        _, result = client.wait_for(MessageType.LIST_CHANNEL, timeout=5)
+        assert len(result.channels) >= 1
+
+    run_gateway_and_client("rudp", port, f"rudp://127.0.0.1:{port}", body=body)
