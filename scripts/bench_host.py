@@ -42,9 +42,11 @@ def run_scenario(name: str, n_subs: int, ticks: int, updates_per_window: int):
         subscribe_to_channel(
             c, ch, control_pb2.ChannelSubscriptionOptions(fanOutIntervalMs=50)
         )
-    # Warm-up past every subscription's first due time (sub_time is the
-    # real channel clock, so 50ms exactly would still be before it).
-    tick_data(ch, 100 * MS)
+    # Warm-up past every subscription's first due time. sub_time is the
+    # real channel clock, and building N subscriptions takes real time,
+    # so the synthetic clock starts one interval past "now".
+    warm = ch.get_time() + 60 * MS
+    tick_data(ch, warm)
     assert all(len(c.sent) == 1 for c in conns), "warm-up must flush first fan-outs"
     t0 = time.perf_counter()
     for i in range(1, ticks + 1):
@@ -54,11 +56,11 @@ def run_scenario(name: str, n_subs: int, ticks: int, updates_per_window: int):
             # would divert windows onto the personal path).
             ch.data.on_update(
                 testdata_pb2.TestChannelDataMessage(text=f"u{i}-{k}"),
-                (100 + i * 50 + k) * MS,
+                warm + (i * 50 + k) * MS,
                 1,
                 None,
             )
-        tick_data(ch, (150 + i * 50) * MS)
+        tick_data(ch, warm + ((i + 1) * 50) * MS)
     dt = time.perf_counter() - t0
     total = sum(
         sum(1 for ctx in c.sent if ctx.msg_type == MessageType.CHANNEL_DATA_UPDATE)
