@@ -216,7 +216,16 @@ def handle_auth(ctx: MessageContext) -> None:
 
     provider = get_auth_provider()
     if provider is None and not global_settings.development:
-        raise RuntimeError("no auth provider configured outside development mode")
+        # run_server() refuses to boot in this state; if a hand-wired setup
+        # reaches here anyway, close the connection instead of raising —
+        # the per-message isolator would swallow the exception and leave
+        # the connection dangling unauthenticated.
+        security_logger().error(
+            "no auth provider configured outside development mode; "
+            "closing connection %d", ctx.connection.id,
+        )
+        ctx.connection.close()
+        return
 
     if (
         ctx.connection.connection_type == ConnectionType.SERVER

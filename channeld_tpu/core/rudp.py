@@ -19,10 +19,12 @@ Datagram layout (little-endian):
 from __future__ import annotations
 
 import asyncio
+import secrets
 import socket
 import struct
 import threading
 import time
+from collections import deque
 from typing import Callable, Optional
 
 from ..utils.logger import get_logger
@@ -35,7 +37,14 @@ MTU = 1200
 SEG_PAYLOAD = MTU - _HEADER.size
 DEFAULT_RTO = 0.1
 MAX_RTO = 1.6
+# Sliding windows, both directions. The send window bounds in-flight
+# segments; overflow queues in a pending buffer whose byte size is capped —
+# a black-holed peer therefore costs at most MAX_PENDING_BYTES + WINDOW
+# datagrams before the session is shed. The receive window bounds the
+# out-of-order reorder buffer so a peer cannot park segments at arbitrary
+# far-future sequence numbers (kcp-go enforces the same with its wnd field).
 WINDOW = 256
+MAX_PENDING_BYTES = 1 << 20
 
 
 class RudpSession:
@@ -48,6 +57,9 @@ class RudpSession:
         # send state
         self._next_seq = 0
         self._unacked: dict[int, tuple[bytes, float, float]] = {}  # seq -> (dgram, sent_at, rto)
+        self._pending: deque[tuple[int, bytes]] = deque()  # (seq, payload) awaiting window
+        self._pending_bytes = 0
+        self.shed = False  # peer stopped acking and the pending cap overflowed
         # receive state
         self._expected = 0
         self._reorder: dict[int, bytes] = {}
@@ -64,31 +76,73 @@ class RudpSession:
     # -- sending ----------------------------------------------------------
 
     def send_stream(self, data: bytes) -> None:
-        """Segment a stream chunk into DATA datagrams."""
+        """Segment a stream chunk into DATA datagrams, respecting the send
+        window: at most WINDOW segments in flight; overflow queues until the
+        peer acks, and a peer that never acks past MAX_PENDING_BYTES gets the
+        session shed (the reliable-UDP analog of a TCP send-buffer timeout)."""
+        if self.closed or self.shed:
+            # A closed/shed session must not keep accumulating pending
+            # segments (the cap below only fires once).
+            return
+        to_send: list[bytes] = []
         with self._lock:
             for off in range(0, len(data), SEG_PAYLOAD):
                 seg = data[off : off + SEG_PAYLOAD]
-                dgram = _HEADER.pack(self.conv, CMD_DATA, self._next_seq,
-                                     self._expected) + seg
-                self._unacked[self._next_seq] = (dgram, time.monotonic(), DEFAULT_RTO)
+                seq = self._next_seq
                 self._next_seq += 1
-                self._send_datagram(dgram)
+                if len(self._unacked) < WINDOW and not self._pending:
+                    dgram = _HEADER.pack(self.conv, CMD_DATA, seq,
+                                         self._expected) + seg
+                    self._unacked[seq] = (dgram, time.monotonic(), DEFAULT_RTO)
+                    to_send.append(dgram)
+                else:
+                    self._pending.append((seq, seg))
+                    self._pending_bytes += len(seg)
+            overflow = self._pending_bytes > MAX_PENDING_BYTES
+        for dgram in to_send:
+            self._send_datagram(dgram)
+        if overflow and not self.closed:
+            self.shed = True
+            logger.warning("rudp conv %d: send buffer overflow, shedding peer",
+                           self.conv)
+            self.fin()
+            if self.on_close is not None:
+                self.on_close()
+
+    def _promote_pending_locked(self) -> list[bytes]:
+        """Move queued segments into the open send window. Caller holds _lock."""
+        out: list[bytes] = []
+        while self._pending and len(self._unacked) < WINDOW:
+            seq, seg = self._pending.popleft()
+            self._pending_bytes -= len(seg)
+            dgram = _HEADER.pack(self.conv, CMD_DATA, seq, self._expected) + seg
+            self._unacked[seq] = (dgram, time.monotonic(), DEFAULT_RTO)
+            out.append(dgram)
+        return out
 
     def tick_retransmit(self) -> None:
         now = time.monotonic()
         with self._lock:
+            to_send = []
             for seq, (dgram, sent_at, rto) in list(self._unacked.items()):
                 if now - sent_at >= rto:
-                    self._send_datagram(dgram)
+                    to_send.append(dgram)
                     self._unacked[seq] = (dgram, now, min(rto * 2, MAX_RTO))
+            to_send.extend(self._promote_pending_locked())
+        for dgram in to_send:
+            self._send_datagram(dgram)
 
     # -- receiving --------------------------------------------------------
 
     def on_datagram(self, cmd: int, seq: int, ack: int, payload: bytes) -> None:
         with self._lock:
-            # Cumulative ack clears everything below it.
+            # Cumulative ack clears everything below it and opens the window
+            # for queued segments.
             for s in [s for s in self._unacked if s < ack]:
                 del self._unacked[s]
+            promoted = self._promote_pending_locked()
+        for dgram in promoted:
+            self._send_datagram(dgram)
         if cmd == CMD_ACK:
             return
         if cmd == CMD_FIN:
@@ -101,7 +155,7 @@ class RudpSession:
         deliver: list[bytes] = []
         with self._lock:
             self._dropped_unacked = False
-            if seq >= self._expected:
+            if self._expected <= seq < self._expected + WINDOW:
                 self._reorder[seq] = payload
                 while self._expected in self._reorder:
                     nxt = self._reorder.pop(self._expected)
@@ -138,7 +192,6 @@ class RudpServerProtocol(asyncio.DatagramProtocol):
         self.sessions: dict[int, RudpSession] = {}
         self._addr_of: dict[int, tuple] = {}
         self._conv_of_addr: dict[tuple, int] = {}
-        self._next_conv = 1
         self._retransmit_task: Optional[asyncio.Task] = None
 
     def connection_made(self, transport) -> None:
@@ -147,9 +200,22 @@ class RudpServerProtocol(asyncio.DatagramProtocol):
 
     async def _retransmit_loop(self) -> None:
         while True:
-            for session in list(self.sessions.values()):
+            for conv, session in list(self.sessions.items()):
+                if session.closed:
+                    # Shed / server-initiated closes never see another
+                    # datagram from the peer, so reap here — otherwise the
+                    # session maps leak and the dead peer's unacked window
+                    # is retransmitted forever.
+                    self._remove_session(conv)
+                    continue
                 session.tick_retransmit()
             await asyncio.sleep(0.02)
+
+    def _remove_session(self, conv: int) -> None:
+        self.sessions.pop(conv, None)
+        addr = self._addr_of.pop(conv, None)
+        if addr is not None and self._conv_of_addr.get(addr) == conv:
+            del self._conv_of_addr[addr]
 
     def datagram_received(self, data: bytes, addr) -> None:
         if len(data) < _HEADER.size:
@@ -161,12 +227,21 @@ class RudpServerProtocol(asyncio.DatagramProtocol):
             # conversation: re-ack the existing one for this address.
             existing = self._conv_of_addr.get(addr)
             if existing is not None and existing in self.sessions:
-                self.transport.sendto(
-                    _HEADER.pack(existing, CMD_SYN_ACK, existing, 0), addr
-                )
-                return
-            conv = self._next_conv
-            self._next_conv += 1
+                if self.sessions[existing].closed:
+                    # Stale session awaiting reap: let the peer start fresh.
+                    self._remove_session(existing)
+                else:
+                    self.transport.sendto(
+                        _HEADER.pack(existing, CMD_SYN_ACK, existing, 0), addr
+                    )
+                    return
+            # Unguessable conversation ids: sequential ids let any remote
+            # host address an established session (inject DATA / forge FIN).
+            # kcp-go keys sessions by source address; we do both — random
+            # conv plus the source-address check below.
+            conv = secrets.randbits(32)
+            while conv == 0 or conv in self.sessions:
+                conv = secrets.randbits(32)
             session = RudpSession(
                 conv, lambda d, a=addr: self.transport.sendto(d, a)
             )
@@ -179,11 +254,15 @@ class RudpServerProtocol(asyncio.DatagramProtocol):
         session = self.sessions.get(conv)
         if session is None:
             return
-        self._addr_of[conv] = addr
+        if self._addr_of.get(conv) != addr:
+            # Spoof guard: a datagram for an established conversation must
+            # come from the address that opened it (kcp-go sessions are
+            # likewise keyed by source address). Dropping, not rebinding —
+            # rebinding would let an attacker steal the session.
+            return
         session.on_datagram(cmd, seq, ack, payload)
         if session.closed:
-            self.sessions.pop(conv, None)
-            self._conv_of_addr.pop(self._addr_of.pop(conv, None), None)
+            self._remove_session(conv)
 
     def close(self) -> None:
         if self._retransmit_task is not None:

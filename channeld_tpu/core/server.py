@@ -15,6 +15,7 @@ connection; a shared pump is the asyncio-idiomatic equivalent).
 from __future__ import annotations
 
 import asyncio
+from collections import deque
 from typing import Optional
 
 from ..utils.logger import get_logger, init_logs
@@ -33,14 +34,36 @@ from .types import ConnectionType
 
 logger = get_logger("server")
 
+# Outbound shed limit per connection. The reference's per-connection writer
+# goroutine blocks on the socket, which is natural backpressure; an asyncio
+# transport instead buffers in memory, so a stalled client subscribed to a
+# busy channel would accumulate unbounded bytes. Past this limit the client
+# is considered dead-slow and is disconnected (it can reconnect and recover
+# via the C19 recovery path).
+MAX_SEND_BUFFER = 4 * 1024 * 1024
+
 
 class TcpTransport:
     def __init__(self, writer: asyncio.StreamWriter):
         self.writer = writer
+        try:
+            writer.transport.set_write_buffer_limits(high=MAX_SEND_BUFFER)
+        except (AttributeError, NotImplementedError):
+            pass
 
     def write(self, data: bytes) -> None:
-        if not self.writer.is_closing():
-            self.writer.write(data)
+        if self.writer.is_closing():
+            return
+        try:
+            buffered = self.writer.transport.get_write_buffer_size()
+        except (AttributeError, NotImplementedError):
+            buffered = 0
+        if buffered + len(data) > MAX_SEND_BUFFER:
+            logger.warning("tcp peer %s too slow (%d bytes unsent); closing",
+                           self.remote_addr(), buffered)
+            self.writer.close()
+            return
+        self.writer.write(data)
 
     def close(self) -> None:
         if not self.writer.is_closing():
@@ -52,20 +75,46 @@ class TcpTransport:
 
 class WebSocketTransport:
     """Wraps a ``websockets`` server connection as a byte sink; each frame
-    is one binary WS message (ref: connection_websocket.go:14-61)."""
+    is one binary WS message (ref: connection_websocket.go:14-61). Frames
+    queue through a single drain task so pending bytes are bounded — a
+    stalled WS peer is shed at MAX_SEND_BUFFER instead of accumulating
+    fire-and-forget send tasks."""
 
     def __init__(self, ws, loop: asyncio.AbstractEventLoop):
         self.ws = ws
         self.loop = loop
+        self._queue: deque[bytes] = deque()
+        self._queued_bytes = 0
+        self._drainer: Optional[asyncio.Future] = None
+        self._shed = False
 
     def write(self, data: bytes) -> None:
-        asyncio.ensure_future(self._send(data), loop=self.loop)
+        if self._shed:
+            return
+        if self._queued_bytes + len(data) > MAX_SEND_BUFFER:
+            logger.warning("ws peer %s too slow (%d bytes unsent); closing",
+                           self.remote_addr(), self._queued_bytes)
+            self._shed = True
+            self.close()
+            return
+        self._queue.append(data)
+        self._queued_bytes += len(data)
+        if self._drainer is None or self._drainer.done():
+            self._drainer = asyncio.ensure_future(self._drain(), loop=self.loop)
 
-    async def _send(self, data: bytes) -> None:
+    async def _drain(self) -> None:
         try:
-            await self.ws.send(data)
+            while self._queue:
+                data = self._queue.popleft()
+                self._queued_bytes -= len(data)
+                await self.ws.send(data)
         except Exception:
-            pass
+            # The socket is dead: stop accepting writes and close, so the
+            # connection doesn't look healthy while dropping every frame.
+            self._queue.clear()
+            self._queued_bytes = 0
+            self._shed = True
+            self.close()
 
     def close(self) -> None:
         asyncio.ensure_future(self.ws.close(), loop=self.loop)
@@ -240,6 +289,19 @@ async def run_server(argv: Optional[list[str]] = None) -> None:
     init_connections(global_settings.server_fsm, global_settings.client_fsm)
     init_channels()
     init_anti_ddos()
+
+    # Fail boot on a missing auth provider outside development: raising at
+    # auth time would be swallowed by the per-message isolator and the
+    # misconfiguration would only surface as dangling unauthenticated
+    # connections in the logs.
+    from .auth import get_auth_provider
+
+    if get_auth_provider() is None and not global_settings.development:
+        logger.error(
+            "no auth provider configured and not in development mode; "
+            "set one with set_auth_provider() before run_server()"
+        )
+        raise SystemExit(1)
 
     from ..spatial.controller import init_spatial_controller
 

@@ -38,8 +38,15 @@ static const size_t MAX_PACKET_SIZE = 0xFFFF;
 static PyObject* CodecError;
 
 // Core frame construction shared by encode_frame and encode_packets.
+// The size cap applies to the uncompressed payload (matching the Python
+// codec and the reference's pre-compression packet cap) so that the
+// decoder's decompression cap never rejects an honestly-encoded frame.
 static PyObject* build_frame(const char* payload, size_t payload_len,
                              int compression) {
+  if (payload_len > MAX_PACKET_SIZE) {
+    PyErr_Format(CodecError, "packet oversized: %zu", payload_len);
+    return nullptr;
+  }
   char* scratch = nullptr;
   if (compression == 1) {
     size_t max_len = snappy_max_compressed_length(payload_len);
@@ -133,6 +140,16 @@ static PyObject* codec_decode_frames(PyObject* self, PyObject* args) {
         Py_DECREF(frames);
         PyBuffer_Release(&buf);
         PyErr_SetString(CodecError, "corrupt snappy length preamble");
+        return nullptr;
+      }
+      // Frame bodies are capped at MAX_PACKET_SIZE pre-compression, so a
+      // preamble claiming more than a small multiple of that is hostile;
+      // allocating it would be a pre-auth memory amplification.
+      if (out_len > 4 * MAX_PACKET_SIZE) {
+        Py_DECREF(frames);
+        PyBuffer_Release(&buf);
+        PyErr_Format(CodecError, "snappy uncompressed length %zu exceeds cap",
+                     out_len);
         return nullptr;
       }
       payload = PyBytes_FromStringAndSize(nullptr, (Py_ssize_t)out_len);
@@ -338,6 +355,12 @@ static PyObject* codec_uncompress(PyObject* self, PyObject* args) {
                                  &out_len) != 0) {
     PyBuffer_Release(&in);
     PyErr_SetString(CodecError, "corrupt snappy length preamble");
+    return nullptr;
+  }
+  if (out_len > 4 * MAX_PACKET_SIZE) {
+    PyBuffer_Release(&in);
+    PyErr_Format(CodecError, "snappy uncompressed length %zu exceeds cap",
+                 out_len);
     return nullptr;
   }
   PyObject* out = PyBytes_FromStringAndSize(nullptr, (Py_ssize_t)out_len);

@@ -38,7 +38,15 @@ class FramingError(Exception):
 
 
 def encode_frame(body: bytes, compression: int = 0) -> bytes:
-    """Wrap a serialized Packet into one wire frame."""
+    """Wrap a serialized Packet into one wire frame.
+
+    The size cap applies to the *uncompressed* body (the reference caps the
+    marshaled Packet at 64KB before compressing, connection.go:626-714), so
+    encode and decode agree on what a legal frame is: the decoder's
+    decompression-bomb cap can then assume no honest peer produced a frame
+    that inflates past a small multiple of MAX_PACKET_SIZE."""
+    if len(body) > MAX_PACKET_SIZE:
+        raise FramingError(f"packet oversized: {len(body)}")
     if _native is not None:
         try:
             return _native.encode_frame(body, compression)

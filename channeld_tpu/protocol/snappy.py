@@ -65,13 +65,24 @@ def compress(data: bytes) -> bytes:
     return out.raw[: out_len.value]
 
 
-def uncompress(data: bytes) -> bytes:
+# A frame body is capped at 64KB before compression (see framing.MAX_PACKET_SIZE),
+# so no legitimate frame decompresses past a small multiple of that. Without
+# this cap a <=64KB frame whose varint preamble claims ~4GiB would trigger a
+# ~4GiB allocation per frame, pre-auth.
+MAX_UNCOMPRESSED_SIZE = 4 * 0xFFFF
+
+
+def uncompress(data: bytes, max_len: int = MAX_UNCOMPRESSED_SIZE) -> bytes:
     lib = _load()
     if lib is None:
         raise RuntimeError("snappy library not available")
     out_len = ctypes.c_size_t()
     if lib.snappy_uncompressed_length(data, len(data), ctypes.byref(out_len)) != 0:
         raise ValueError("corrupt snappy data (bad length preamble)")
+    if out_len.value > max_len:
+        raise ValueError(
+            f"snappy uncompressed length {out_len.value} exceeds cap {max_len}"
+        )
     out = ctypes.create_string_buffer(out_len.value)
     if lib.snappy_uncompress(data, len(data), out, ctypes.byref(out_len)) != 0:
         raise ValueError("corrupt snappy data")
