@@ -8,6 +8,9 @@ import sys
 
 
 def main() -> None:
+    from .utils.devices import pin_cpu_if_virtual_devices
+
+    pin_cpu_if_virtual_devices()
     from .core.server import run_server
 
     try:

@@ -121,6 +121,13 @@ class GlobalSettings:
     spatial_backend: str = "host"  # "host" | "tpu"
     tpu_entity_capacity: int = 1 << 17
     tpu_query_capacity: int = 1 << 12
+    # Device mesh for the spatial engine: 0 devices = single-device step;
+    # N>0 shards the entity arrays over the first N jax devices, and
+    # hosts>1 arranges them as a (hosts, chips) DCN x ICI mesh — the TPU
+    # equivalent of the reference's multi-server spatial world
+    # (ref: spatial.go:387-590).
+    tpu_mesh_devices: int = 0
+    tpu_mesh_hosts: int = 1
 
     def get_channel_settings(self, ct: ChannelType) -> ChannelSettings:
         st = self.channel_settings.get(ct)
@@ -195,6 +202,11 @@ class GlobalSettings:
         p.add_argument("-spatial-backend", type=str, default=self.spatial_backend,
                        choices=("host", "tpu"),
                        help="where the AOI/fan-out decision pass runs")
+        p.add_argument("-mesh-devices", type=int, default=self.tpu_mesh_devices,
+                       help="shard the spatial engine over N devices "
+                            "(0 = single-device step)")
+        p.add_argument("-mesh-hosts", type=int, default=self.tpu_mesh_hosts,
+                       help="arrange the mesh devices as (hosts, chips)")
         args = p.parse_args(argv)
 
         self.development = args.dev
@@ -227,6 +239,8 @@ class GlobalSettings:
         self.max_failed_auth_attempts = args.mfaa
         self.max_fsm_disallowed = args.mfd
         self.spatial_backend = args.spatial_backend
+        self.tpu_mesh_devices = args.mesh_devices
+        self.tpu_mesh_hosts = args.mesh_hosts
         self.snapshot_path = args.snapshot
         self.snapshot_interval_s = args.snapshot_interval
         self.import_modules = [m for m in args.imports.split(",") if m]

@@ -43,7 +43,28 @@ class SpatialEngine:
         query_capacity: int = 1 << 12,
         sub_capacity: int = 1 << 16,
         max_handovers: int = 4096,
+        mesh=None,
     ):
+        """``mesh``: a jax.sharding.Mesh to shard the entity slot arrays
+        over (from parallel.mesh.make_mesh / make_mesh_2d). None = the
+        single-device fused step. The serving results are identical either
+        way (pinned by tests/test_ops.py engine parity); the mesh step
+        exchanges per-cell occupancy with psum over ICI/DCN and gathers
+        per-shard handover rows — the TPU answer to the reference's
+        multi-server spatial world (ref: spatial.go:387-590)."""
+        self._mesh = mesh
+        self._mesh_step = None
+        if mesh is not None:
+            n_dev = int(mesh.devices.size)
+            # Entity arrays shard evenly over every mesh axis.
+            entity_capacity = ((entity_capacity + n_dev - 1) // n_dev) * n_dev
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            self._entity_ns = NamedSharding(
+                mesh, PartitionSpec(tuple(mesh.axis_names))
+            )
+        else:
+            self._entity_ns = None
         self.grid = grid
         self.entity_capacity = entity_capacity
         self.query_capacity = query_capacity
@@ -81,19 +102,27 @@ class SpatialEngine:
         self._sub_free = list(range(sub_capacity - 1, -1, -1))
         self._subs_dirty = True
 
-        # Device state.
-        self._d_positions = jnp.asarray(self._positions)
-        self._d_valid = jnp.asarray(self._valid)
-        self._d_cell = jnp.full(entity_capacity, -1, jnp.int32)
+        # Device state (entity arrays sharded over the mesh when given).
+        if self._entity_ns is not None:
+            self._d_positions = jax.device_put(self._positions, self._entity_ns)
+            self._d_valid = jax.device_put(self._valid, self._entity_ns)
+            self._d_cell = jax.device_put(
+                np.full(entity_capacity, -1, np.int32), self._entity_ns
+            )
+        else:
+            self._d_positions = jnp.asarray(self._positions)
+            self._d_valid = jnp.asarray(self._valid)
+            self._d_cell = jnp.full(entity_capacity, -1, jnp.int32)
         self._d_queries: Optional[QuerySet] = None
         self._d_sub_state = None
 
         self._start = time.monotonic()
         self.last_result: Optional[dict] = None
-        # Fused Mosaic assign+count on TPU backends (pallas_kernels).
+        # Fused Mosaic assign+count on TPU backends (pallas_kernels);
+        # the sharded step uses plain XLA inside shard_map.
         from .pallas_kernels import pallas_available
 
-        self.use_pallas = pallas_available()
+        self.use_pallas = pallas_available() and mesh is None
 
     # ---- entity slots ----------------------------------------------------
 
@@ -245,16 +274,29 @@ class SpatialEngine:
 
     # ---- the tick --------------------------------------------------------
 
+    def _keep_entity_sharding(self, arr):
+        """Scatter updates must not silently migrate a mesh-sharded array
+        (device_put is a no-op when the sharding already matches)."""
+        if self._entity_ns is None:
+            return arr
+        return jax.device_put(arr, self._entity_ns)
+
     def _flush_host_state(self) -> None:
         if self._dirty_slots:
             idx = np.fromiter(self._dirty_slots, np.int32, len(self._dirty_slots))
-            self._d_positions = self._d_positions.at[idx].set(self._positions[idx])
-            self._d_valid = self._d_valid.at[idx].set(self._valid[idx])
+            self._d_positions = self._keep_entity_sharding(
+                self._d_positions.at[idx].set(self._positions[idx])
+            )
+            self._d_valid = self._keep_entity_sharding(
+                self._d_valid.at[idx].set(self._valid[idx])
+            )
             self._dirty_slots.clear()
         if self._seed_cells:
             slots = np.fromiter(self._seed_cells.keys(), np.int32, len(self._seed_cells))
             cells = np.fromiter(self._seed_cells.values(), np.int32, len(self._seed_cells))
-            self._d_cell = self._d_cell.at[slots].set(cells)
+            self._d_cell = self._keep_entity_sharding(
+                self._d_cell.at[slots].set(cells)
+            )
             self._seed_cells.clear()
         spots_changed = False
         if self._q_spot_dist is not None:
@@ -294,17 +336,20 @@ class SpatialEngine:
         if now_ms is None:
             now_ms = self.now_ms()
         self._flush_host_state()
-        out = spatial_step(
-            self.grid,
-            self._d_positions,
-            self._d_cell,
-            self._d_valid,
-            self._d_queries,
-            self._d_sub_state,
-            self.max_handovers,
-            jnp.int32(now_ms),
-            use_pallas=self.use_pallas,
-        )
+        if self._mesh is not None:
+            out = self._mesh_tick(now_ms)
+        else:
+            out = spatial_step(
+                self.grid,
+                self._d_positions,
+                self._d_cell,
+                self._d_valid,
+                self._d_queries,
+                self._d_sub_state,
+                self.max_handovers,
+                jnp.int32(now_ms),
+                use_pallas=self.use_pallas,
+            )
         # Baseline for the next tick: crossings that overflowed the handover
         # row budget keep their old cell so they are re-detected, not lost.
         self._d_cell = out["committed_prev"]
@@ -316,10 +361,49 @@ class SpatialEngine:
         self.last_result = out
         return out
 
+    def _mesh_tick(self, now_ms: int) -> dict:
+        """The sharded decision pass, normalized to the single-device
+        result contract (handover_count + merged global-slot rows)."""
+        from ..parallel.mesh import (
+            build_sharded_step,
+            merge_handover_shards,
+            sharded_spatial_step,
+        )
+
+        with_spots = self._d_queries.spot_dist is not None
+        if self._mesh_step is None or self._mesh_step.with_spots != with_spots:
+            n_shards = int(self._mesh.devices.size)
+            per_shard = max(1, -(-self.max_handovers // n_shards))
+            self._mesh_step = build_sharded_step(
+                self.grid, self._mesh, per_shard, with_spots
+            )
+        out = sharded_spatial_step(
+            self._mesh_step,
+            self._d_positions,
+            self._d_cell,
+            self._d_valid,
+            self._d_queries,
+            self._d_sub_state,
+            now_ms,
+        )
+        count, rows = merge_handover_shards(
+            out["handover_counts"], out["handovers"]
+        )
+        out["handover_count"] = count
+        out["handovers"] = rows
+        return out
+
     def handover_list(self, result: dict) -> list[tuple[int, int, int]]:
-        """[(entity_id, src_cell, dst_cell)] from a tick result."""
+        """[(entity_id, src_cell, dst_cell)] from a tick result.
+
+        Every row present must be consumed: the device already committed
+        these crossings (committed_prev), so a clamped row would be a
+        permanently lost handover. Mesh ticks can report slightly more
+        than max_handovers (per-shard budgets round up); single-device
+        counts beyond the row budget re-detect next tick."""
         count = int(result["handover_count"])
-        rows = np.asarray(result["handovers"][: min(count, self.max_handovers)])
+        rows = np.asarray(result["handovers"])
+        rows = rows[: min(count, len(rows))]
         return [
             (int(self._entity_of_slot[slot]), int(src), int(dst))
             for slot, src, dst in rows

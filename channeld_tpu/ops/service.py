@@ -42,6 +42,16 @@ class SpatialDecisionServicer:
         from .engine import SpatialEngine
         from .spatial_ops import GridSpec
 
+        from ..parallel.mesh import mesh_from_config
+
+        try:
+            mesh = mesh_from_config(
+                request.meshDevices, request.meshHosts or 1
+            )
+        except ValueError as e:
+            import grpc
+
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
         with self._lock:
             self.engine = SpatialEngine(
                 GridSpec(
@@ -55,10 +65,13 @@ class SpatialDecisionServicer:
                 entity_capacity=request.entityCapacity or (1 << 17),
                 query_capacity=request.queryCapacity or (1 << 12),
                 sub_capacity=request.subCapacity or (1 << 16),
+                mesh=mesh,
             )
         logger.info(
-            "configured engine: %dx%d grid, %d entity slots",
-            request.gridCols, request.gridRows, request.entityCapacity or (1 << 17),
+            "configured engine: %dx%d grid, %d entity slots, mesh=%s",
+            request.gridCols, request.gridRows,
+            request.entityCapacity or (1 << 17),
+            f"{request.meshDevices}dev" if request.meshDevices else "none",
         )
         return Empty()
 

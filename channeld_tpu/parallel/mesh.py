@@ -60,6 +60,22 @@ def make_mesh_2d(n_hosts: int, devices: Optional[list] = None) -> Mesh:
     return Mesh(arr, (HOST_AXIS, DATA_AXIS))
 
 
+def mesh_from_config(n_devices: int, n_hosts: int = 1) -> Optional[Mesh]:
+    """Mesh for the serving engine from config/flag values; None when
+    n_devices is 0 (single-device step)."""
+    if not n_devices:
+        return None
+    devices = jax.devices()
+    if len(devices) < n_devices:
+        raise ValueError(
+            f"mesh wants {n_devices} devices but only {len(devices)} present"
+        )
+    devices = devices[:n_devices]
+    if n_hosts > 1:
+        return make_mesh_2d(n_hosts, devices)
+    return make_mesh(devices)
+
+
 def entity_sharding(mesh: Mesh) -> NamedSharding:
     """Joint sharding over every mesh axis — matches build_sharded_step's
     entity spec for both 1D and 2D meshes."""
@@ -98,9 +114,13 @@ def build_sharded_step(grid: GridSpec, mesh: Mesh, max_handovers_per_shard: int,
                            spot_dist)
         cell_of = assign_cells(grid, positions, valid)
         handover_mask = detect_handovers(prev_cell, cell_of)
-        ho_count, ho_rows, _reported = compact_handovers(
+        ho_count, ho_rows, reported = compact_handovers(
             handover_mask, prev_cell, cell_of, max_handovers_per_shard
         )
+        # Crossings that overflowed this shard's row budget keep their old
+        # cell as next tick's baseline so they are re-detected, not lost —
+        # the same overflow contract as the single-device spatial_step.
+        committed_prev = jnp.where(handover_mask & ~reported, prev_cell, cell_of)
         # Local slot indices -> global entity slots (row-major shard order).
         shard_index = jnp.int32(0)
         for axis in axes:
@@ -120,7 +140,8 @@ def build_sharded_step(grid: GridSpec, mesh: Mesh, max_handovers_per_shard: int,
         # Gather every shard's handover rows so the host reads one array.
         all_counts = jax.lax.all_gather(ho_count, axes)
         all_rows = jax.lax.all_gather(ho_rows, axes)
-        return cell_of, all_counts, all_rows, counts, interest, dist, due, new_last
+        return (cell_of, committed_prev, all_counts, all_rows, counts,
+                interest, dist, due, new_last)
 
     sharded = shard_map(
         shard_fn,
@@ -133,13 +154,22 @@ def build_sharded_step(grid: GridSpec, mesh: Mesh, max_handovers_per_shard: int,
             P(),  # now_ms
         ),
         out_specs=(
-            entity_spec,  # cell_of
+            entity_spec, entity_spec,  # cell_of, committed_prev
             P(), P(),  # handover counts/rows (gathered, replicated)
             P(), P(), P(), P(), P(),
         ),
         check_vma=False,
     )
-    jitted = jax.jit(sharded, donate_argnums=(1,))
+
+    def full(*args):
+        (cell_of, committed_prev, all_counts, all_rows, counts, interest,
+         dist, due, new_last) = sharded(*args)
+        # Bit-packed due mask: same D2H-thrift trick as spatial_step.
+        due_packed = jnp.packbits(due)
+        return (cell_of, committed_prev, all_counts, all_rows, counts,
+                interest, dist, due, due_packed, new_last)
+
+    jitted = jax.jit(full, donate_argnums=(1,))
 
     def step(*args):
         return jitted(*args)
@@ -162,7 +192,8 @@ def sharded_spatial_step(step_fn, positions, prev_cell, valid, queries: QuerySet
     spot_args = (
         (queries.spot_dist,) if getattr(step_fn, "with_spots", False) else ()
     )
-    cell_of, ho_counts, ho_rows, counts, interest, dist, due, new_last = step_fn(
+    (cell_of, committed_prev, ho_counts, ho_rows, counts, interest, dist,
+     due, due_packed, new_last) = step_fn(
         positions, prev_cell, valid,
         queries.kind, queries.center, queries.extent, queries.direction,
         queries.angle, *spot_args, last_ms, interval_ms, active,
@@ -170,11 +201,28 @@ def sharded_spatial_step(step_fn, positions, prev_cell, valid, queries: QuerySet
     )
     return {
         "cell_of": cell_of,
+        "committed_prev": committed_prev,
         "handover_counts": ho_counts,
         "handovers": ho_rows,
         "cell_counts": counts,
         "interest": interest,
         "dist": dist,
         "due": due,
+        "due_packed": due_packed,
         "new_last_fanout_ms": new_last,
     }
+
+
+def merge_handover_shards(ho_counts, ho_rows) -> "tuple[int, object]":
+    """Flatten per-shard gathered handover rows into one (count, rows[K,3])
+    array in shard order, dropping unused row slots. Host-side numpy."""
+    import numpy as np
+
+    counts = np.asarray(ho_counts).reshape(-1)
+    rows = np.asarray(ho_rows)
+    rows = rows.reshape(counts.shape[0], -1, 3)
+    per_shard = rows.shape[1]
+    merged = [rows[i, : min(int(counts[i]), per_shard)] for i in range(len(counts))]
+    flat = (np.concatenate(merged, axis=0) if merged
+            else np.zeros((0, 3), np.int32))
+    return int(flat.shape[0]), flat

@@ -55,6 +55,20 @@ class TPUSpatialController(StaticGrid2DSpatialController):
 
         events.channel_removed.listen_for(self, _on_channel_removed)
 
+        # Mesh selection: the controller Config's MeshDevices/MeshHosts keys
+        # win over the -mesh-devices/-mesh-hosts flags. With a mesh, the
+        # live serving engine runs the shard_map step over the device mesh
+        # — the gateway-facing results are identical (pinned by
+        # test_ops.py::test_engine_mesh_matches_single_device).
+        from ..parallel.mesh import mesh_from_config
+
+        mesh = mesh_from_config(
+            int(config.get("MeshDevices", global_settings.tpu_mesh_devices)),
+            int(config.get("MeshHosts", global_settings.tpu_mesh_hosts)),
+        )
+        if mesh is not None:
+            logger.info("spatial engine meshed over %s", mesh)
+
         self.engine = SpatialEngine(
             GridSpec(
                 offset_x=self.world_offset_x,
@@ -66,6 +80,7 @@ class TPUSpatialController(StaticGrid2DSpatialController):
             ),
             entity_capacity=global_settings.tpu_entity_capacity,
             query_capacity=global_settings.tpu_query_capacity,
+            mesh=mesh,
         )
 
     # ---- decision plane --------------------------------------------------

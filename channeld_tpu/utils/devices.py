@@ -1,0 +1,24 @@
+"""Device-platform selection helpers shared by every process entrypoint."""
+
+from __future__ import annotations
+
+import os
+
+
+def pin_cpu_if_virtual_devices() -> None:
+    """When XLA_FLAGS requests forced host-platform devices (tests/CI on a
+    virtual CPU mesh), pin the CPU backend before jax initializes — this
+    harness ignores the JAX_PLATFORMS env var, so the config API is the
+    only reliable switch. Harmless after backend init or without jax.
+
+    Call sites: tests/conftest.py, __graft_entry__.dryrun_multichip, the
+    gateway entrypoint (__main__), and the sidecar.
+    """
+    if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+        return
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass

@@ -15,9 +15,9 @@ if "xla_force_host_platform_device_count" not in _flags:
 # Force CPU even though the image pins the axon TPU platform (this harness
 # ignores the JAX_PLATFORMS env var, so use the config API): tests exercise
 # sharding on 8 virtual devices; bench.py uses the real chip.
-import jax  # noqa: E402
+from channeld_tpu.utils.devices import pin_cpu_if_virtual_devices  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+pin_cpu_if_virtual_devices()
 
 import pytest  # noqa: E402
 

@@ -218,6 +218,17 @@ def test_locked_entity_does_not_hand_over():
 def test_tpu_controller_handover_parity():
     """The device-backed controller detects the same crossing and runs the
     same orchestration as the host path."""
+    _run_tpu_handover_parity({})
+
+
+def test_tpu_controller_handover_parity_meshed():
+    """Same orchestration with the serving engine sharded over the full
+    8-virtual-device mesh (config MeshDevices) — the gateway path the
+    reference serves with multiple spatial servers (spatial.go:387-590)."""
+    _run_tpu_handover_parity({"MeshDevices": 8})
+
+
+def _run_tpu_handover_parity(extra_cfg):
     from channeld_tpu.spatial.tpu_controller import TPUSpatialController
     from channeld_tpu.core.settings import global_settings
 
@@ -228,8 +239,10 @@ def test_tpu_controller_handover_parity():
     ctl.load_config(
         dict(WorldOffsetX=0, WorldOffsetZ=0, GridWidth=100, GridHeight=100,
              GridCols=2, GridRows=1, ServerCols=2, ServerRows=1,
-             ServerInterestBorderSize=1)
+             ServerInterestBorderSize=1, **extra_cfg)
     )
+    if extra_cfg.get("MeshDevices"):
+        assert ctl.engine._mesh is not None
     set_spatial_controller(ctl)
     server_a = StubConnection(1, ConnectionType.SERVER)
     server_b = StubConnection(2, ConnectionType.SERVER)

@@ -16,15 +16,18 @@ def sidecar():
     server.stop(None)
 
 
-def test_sidecar_step_roundtrip(sidecar):
+@pytest.mark.parametrize("mesh_devices", [0, 8])
+def test_sidecar_step_roundtrip(sidecar, mesh_devices):
     from channeld_tpu.ops.service_pb2 import StepRequest
 
     client, servicer = sidecar
     client.configure(
         worldOffsetX=-150, worldOffsetZ=-150, gridWidth=100, gridHeight=100,
         gridCols=3, gridRows=3, entityCapacity=64, queryCapacity=8,
-        subCapacity=8,
+        subCapacity=8, meshDevices=mesh_devices,
     )
+    if mesh_devices:
+        assert servicer.engine._mesh is not None
     req = StepRequest(nowMs=10)
     req.updates.add(entityId=0x80001, x=-100, y=0, z=-100)  # cell 0
     req.updates.add(entityId=0x80002, x=0, y=0, z=0)  # cell 4
