@@ -112,5 +112,96 @@ def main():
     }), flush=True)
 
 
+def bench_fanout_decision():
+    """Per-tick fan-out decision cost: host scan (every subscriber gets a
+    time check, ref data.go:175-291) vs device due-mask consumption (only
+    due subscribers are visited). The device cost is flat in subscriber
+    count — VERDICT r1 item #3's acceptance metric."""
+    from channeld_tpu.core.channel import Channel
+    from channeld_tpu.core.data import FanOutConnection, ChannelData, tick_data
+    from channeld_tpu.core.subscription import ChannelSubscription
+    from channeld_tpu.core.types import ChannelType
+    from channeld_tpu.models import sim_pb2
+    from channeld_tpu.protocol import control_pb2
+    from channeld_tpu.spatial import controller as ctl_mod
+
+    class _Conn:
+        __slots__ = ("id",)
+
+        def __init__(self, cid):
+            self.id = cid
+
+        def is_closing(self):
+            return False
+
+        def send(self, ctx):
+            pass
+
+    class _FakeDeviceCtl:
+        """Publishes a pending due queue, like TPUSpatialController."""
+
+        def __init__(self):
+            self.seq = 0
+            self.due = frozenset()
+            self.pending = {}
+
+        def publish(self):
+            self.seq += 1
+            for slot in self.due:
+                self.pending[slot] = self.seq
+
+        def device_due(self, channel_id):
+            return (self.seq, self.pending) if self.seq else None
+
+        def device_sub_first_fanout(self, slot):
+            pass
+
+    DUE = 128  # due subscribers per tick, independent of S
+    for n_subs in (1_000, 10_000, 50_000):
+        ch = Channel(0x10000 + 1, ChannelType.SPATIAL)
+        ch.data = ChannelData(sim_pb2.SimSpatialChannelData())
+        far_future = 1 << 60
+        for i in range(n_subs):
+            conn = _Conn(i + 10)
+            foc = FanOutConnection(conn=conn, had_first_fanout=True,
+                                   last_fanout_time=far_future,
+                                   device_sub_slot=i)
+            ch.fan_out_queue.append(foc)
+            ch.device_sub_slots[i] = foc
+            ch.subscribed_connections[conn] = ChannelSubscription(
+                options=control_pb2.ChannelSubscriptionOptions(
+                    dataAccess=2, fanOutIntervalMs=50),
+                sub_time=0, fanout_conn=foc,
+            )
+
+        # Host scan: no controller -> every subscriber time-checked.
+        prev_ctl = ctl_mod.get_spatial_controller()
+        ctl_mod.set_spatial_controller(None)
+        reps = max(3, 300_000 // n_subs)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            tick_data(ch, now=0)
+        host_us = (time.perf_counter() - t0) / reps * 1e6
+
+        # Device mask: only the DUE slots are visited.
+        fake = _FakeDeviceCtl()
+        fake.due = frozenset(range(0, n_subs, max(1, n_subs // DUE)))
+        ctl_mod.set_spatial_controller(fake)
+        t0 = time.perf_counter()
+        for rep in range(reps):
+            fake.publish()  # fresh decisions each engine tick
+            tick_data(ch, now=0)
+        device_us = (time.perf_counter() - t0) / reps * 1e6
+        ctl_mod.set_spatial_controller(prev_ctl)
+        print(json.dumps({
+            "metric": f"fanout_decision_{n_subs}_subs",
+            "host_scan_us_per_tick": round(host_us, 1),
+            "device_mask_us_per_tick": round(device_us, 1),
+            "due_per_tick": len(fake.due),
+            "speedup": round(host_us / device_us, 1),
+        }), flush=True)
+
+
 if __name__ == "__main__":
     main()
+    bench_fanout_decision()

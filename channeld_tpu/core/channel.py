@@ -102,6 +102,13 @@ class Channel:
         self.entity_controller = None
         self.in_msg_queue: asyncio.Queue = asyncio.Queue(maxsize=QUEUE_CAPACITY)
         self.fan_out_queue: list[FanOutConnection] = []
+        # Spatial channels with a TPU controller: engine sub-table slot ->
+        # FanOutConnection, for consuming the batched device due mask;
+        # subs without a device slot (table full / pre-engine) keep the
+        # host time check via this side list — kept separately so the
+        # device tick never rescans the whole fan-out queue.
+        self.device_sub_slots: dict[int, FanOutConnection] = {}
+        self.device_fallback_focs: list[FanOutConnection] = []
         self.start_ns = time.monotonic_ns()
         st = global_settings.get_channel_settings(self.channel_type)
         self.tick_interval = st.tick_interval_ms / 1000.0
@@ -421,7 +428,14 @@ class Channel:
                         )
                     )
 
+            sub = self.subscribed_connections[conn]
             del self.subscribed_connections[conn]
+            # Free the engine sub slot on the crash/drop path too (explicit
+            # unsubscribe is not the only teardown) — idempotent with the
+            # tick_data dead-conn sweep.
+            from .subscription import release_device_fanout
+
+            release_device_fanout(self, sub.fanout_conn)
             if self.get_owner() is conn:
                 self.set_owner(None)
                 if self.channel_type == ChannelType.GLOBAL:

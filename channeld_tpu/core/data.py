@@ -115,6 +115,10 @@ class FanOutConnection:
     had_first_fanout: bool = False
     last_fanout_time: int = 0  # ns, channel time
     last_message_index: int = 0
+    # Device fan-out plane (spatial channels with a TPU controller): the
+    # engine sub-table slot whose batched due bit replaces the per-sub
+    # host time check (consumed via the controller's pending due queue).
+    device_sub_slot: Optional[int] = None
 
 
 class ChannelData:
@@ -190,11 +194,36 @@ def _accumulate_window(data: "ChannelData", window: list, fresh: bool = False):
     return acc
 
 
+def _device_due_view(channel: "Channel"):
+    """(ctl, engine_seq, pending {slot: seq}) from the TPU controller's
+    batched ticks, for spatial channels with device-registered subs;
+    None -> host path (ref: the host scan this replaces is
+    data.go:175-291). Pending entries survive engine ticks until this
+    channel consumes them."""
+    if not channel.device_sub_slots:
+        return None
+    from ..spatial.controller import get_spatial_controller
+
+    ctl = get_spatial_controller()
+    view = getattr(ctl, "device_due", None)
+    if view is None:
+        return None
+    due = view(channel.id)
+    if due is None:
+        return None
+    return (ctl,) + due
+
+
 def tick_data(channel: "Channel", now: int) -> None:
     """The per-tick fan-out decision + send loop (ref: data.go:175-291).
 
     ``now`` is channel time (integer ns since channel start) so tests can
     drive it with a synthetic clock.
+
+    Spatial channels under a TPU controller consume the batched device due
+    mask: only subscribers the engine marked due are visited (flat host
+    cost in subscriber count); subscriptions without a device slot — table
+    full or pre-engine — keep the host time check.
     """
     data = channel.data
     if data is None or data.msg is None:
@@ -215,13 +244,33 @@ def tick_data(channel: "Channel", now: int) -> None:
     body_cache: dict = {}  # id(update_msg) -> (msg ref, shared MessageContext)
 
     queue = channel.fan_out_queue
-    for foc in list(queue):
+    device = _device_due_view(channel)
+    if device is not None:
+        ctl, seq, pending = device
+        # Consume this channel's own pending due decisions — O(own due),
+        # never an iteration of the slot table or the fan-out queue —
+        # plus any host-fallback entries.
+        iterate = []
+        slots = channel.device_sub_slots
+        for slot in list(pending):
+            del pending[slot]
+            foc = slots.get(slot)
+            if foc is not None:
+                iterate.append(foc)
+        iterate.extend(channel.device_fallback_focs)
+    else:
+        iterate = list(queue)
+
+    for foc in iterate:
         conn = foc.conn
         if conn is None or conn.is_closing():
             try:
                 queue.remove(foc)
             except ValueError:
                 pass
+            from .subscription import release_device_fanout
+
+            release_device_fanout(channel, foc)
             continue
         cs = channel.subscribed_connections.get(conn)
         if cs is None or cs.options.dataAccess == ChannelDataAccess.NO_ACCESS:
@@ -230,8 +279,15 @@ def tick_data(channel: "Channel", now: int) -> None:
         #  |------FanOutDelay------|---FanOutInterval---|
         #  subTime                 firstFanOut          secondFanOut
         next_fanout_time = foc.last_fanout_time + cs.options.fanOutIntervalMs * NS_PER_MS
-        if now < next_fanout_time:
-            continue
+        if device is None or foc.device_sub_slot is None:
+            # Host time check (no engine, or no device slot for this sub).
+            if now < next_fanout_time:
+                continue
+        else:
+            # The device already decided this sub is due. The engine clock
+            # can run marginally ahead of this channel's; clamp the window
+            # end so the bisect below never claims unseen future arrivals.
+            next_fanout_time = min(next_fanout_time, now)
 
         latest_fanout_time = next_fanout_time
 
@@ -241,6 +297,9 @@ def tick_data(channel: "Channel", now: int) -> None:
             foc.had_first_fanout = True
             foc.last_message_index = data.msg_index
             latest_fanout_time = now
+            if device is not None and foc.device_sub_slot is not None:
+                # Mirror the window snap on the device sub clock.
+                ctl.device_sub_first_fanout(foc.device_sub_slot)
         elif data.update_msg_buffer:
             if arrivals is None:
                 arrivals = [be.arrival_time for be in data.update_msg_buffer]
@@ -293,8 +352,10 @@ def tick_data(channel: "Channel", now: int) -> None:
 
     # Keep the queue ordered by last_fanout_time (the reference maintains
     # this invariant with in-place move-to-back; a stable sort is the same
-    # end state).
-    queue.sort(key=lambda f: f.last_fanout_time)
+    # end state). Device mode doesn't iterate the queue, so its order is
+    # re-established lazily if the engine ever goes away.
+    if device is None:
+        queue.sort(key=lambda f: f.last_fanout_time)
 
 
 def fan_out_data_update(

@@ -56,8 +56,23 @@ def subscribe_to_channel(
         data_access_changed = False
         if options is not None:
             before = cs.options.dataAccess
+            before_interval = cs.options.fanOutIntervalMs
             cs.options.MergeFrom(options)
             data_access_changed = before != cs.options.dataAccess
+            if cs.options.fanOutIntervalMs != before_interval:
+                slot = cs.fanout_conn.device_sub_slot
+                if slot is not None:
+                    ctl = _device_fanout_controller()
+                    if ctl is not None:
+                        ctl.device_sub_set_interval(
+                            slot, cs.options.fanOutIntervalMs
+                        )
+                # A now-slower subscriber widens the ring retention window,
+                # or early-window updates would be evicted before its next
+                # fan-out (same bookkeeping as the fresh-subscribe path).
+                if (ch.data is not None and
+                        ch.data.max_fanout_interval_ms < cs.options.fanOutIntervalMs):
+                    ch.data.max_fanout_interval_ms = cs.options.fanOutIntervalMs
         return cs, data_access_changed
 
     merged = default_sub_options(ch.channel_type)
@@ -87,8 +102,53 @@ def subscribe_to_channel(
 
     if ch.channel_type == ChannelType.SPATIAL:
         conn.spatial_subscriptions[ch.id] = cs.options
+        # Device fan-out plane: register the sub in the engine's batched
+        # due table so tick_data takes the decision from the device tick
+        # (host time-check fallback when no TPU controller / table full).
+        ctl = _device_fanout_controller()
+        slot = None
+        if ctl is not None:
+            slot = ctl.device_sub_add(
+                merged.fanOutIntervalMs, merged.fanOutDelayMs, ch.id
+            )
+        if slot is not None:
+            foc.device_sub_slot = slot
+            ch.device_sub_slots[slot] = foc
+        else:
+            ch.device_fallback_focs.append(foc)
 
     return cs, True
+
+
+def _device_fanout_controller():
+    """The active TPU spatial controller, or None (duck-typed: anything
+    with the device_sub_* API)."""
+    from ..spatial.controller import get_spatial_controller
+
+    ctl = get_spatial_controller()
+    if ctl is not None and hasattr(ctl, "device_sub_add"):
+        return ctl
+    return None
+
+
+def release_device_fanout(ch: "Channel", foc: FanOutConnection) -> None:
+    """Free a fan-out connection's engine sub slot (or host-fallback list
+    entry). Every subscription-teardown path must come through here —
+    explicit unsubscribe, the channel's closed-connection prune, and
+    tick_data's dead-conn sweep — or engine slots leak one per disconnect
+    until the table is exhausted."""
+    slot = foc.device_sub_slot
+    if slot is not None:
+        foc.device_sub_slot = None
+        ch.device_sub_slots.pop(slot, None)
+        ctl = _device_fanout_controller()
+        if ctl is not None:
+            ctl.device_sub_remove(slot)
+    else:
+        try:
+            ch.device_fallback_focs.remove(foc)
+        except ValueError:
+            pass
 
 
 def unsubscribe_from_channel(
@@ -105,4 +165,5 @@ def unsubscribe_from_channel(
     del ch.subscribed_connections[conn]
     if ch.channel_type == ChannelType.SPATIAL:
         conn.spatial_subscriptions.pop(ch.id, None)
+        release_device_fanout(ch, cs.fanout_conn)
     return cs.options

@@ -96,11 +96,16 @@ class SpatialEngine:
         self._spot_dirty_rows: set[int] = set()
         self._queries_dirty = True
 
+        # Host staging for the sub table. The device's last-fan-out column
+        # is authoritative after each tick (fanout_due advances it); the
+        # host mirror only carries *explicit* writes (add/reset/interval),
+        # applied as row scatters — a full rebuild from the mirror would
+        # snap every sub's window start back to stale values.
         self._sub_last = np.zeros(sub_capacity, np.int32)
         self._sub_interval = np.zeros(sub_capacity, np.int32)
         self._sub_active = np.zeros(sub_capacity, bool)
         self._sub_free = list(range(sub_capacity - 1, -1, -1))
-        self._subs_dirty = True
+        self._sub_dirty_slots: set[int] = set()
 
         # Device state (entity arrays sharded over the mesh when given).
         if self._entity_ns is not None:
@@ -264,13 +269,24 @@ class SpatialEngine:
         self._sub_last[s] = first_due_ms
         self._sub_interval[s] = interval_ms
         self._sub_active[s] = True
-        self._subs_dirty = True
+        self._sub_dirty_slots.add(s)
         return s
 
     def remove_subscription(self, s: int) -> None:
         self._sub_active[s] = False
         self._sub_free.append(s)
-        self._subs_dirty = True
+        self._sub_dirty_slots.add(s)
+
+    def set_sub_interval(self, s: int, interval_ms: int) -> None:
+        """Re-subscription merged new options (ref: subscription.go:34-60)."""
+        self._sub_interval[s] = interval_ms
+        self._sub_dirty_slots.add(s)
+
+    def reset_sub_clock(self, s: int, now_ms: int) -> None:
+        """Snap the sub's window start to ``now`` — mirrors the host path's
+        first-fan-out behavior (tick_data sets latest_fanout_time = now)."""
+        self._sub_last[s] = now_ms
+        self._sub_dirty_slots.add(s)
 
     # ---- the tick --------------------------------------------------------
 
@@ -323,13 +339,26 @@ class SpatialEngine:
                 self._d_spot_dist,
             )
             self._queries_dirty = False
-        if self._d_sub_state is None or self._subs_dirty:
+        if self._d_sub_state is None:
             self._d_sub_state = (
                 jnp.asarray(self._sub_last),
                 jnp.asarray(self._sub_interval),
                 jnp.asarray(self._sub_active),
             )
-            self._subs_dirty = False
+            self._sub_dirty_slots.clear()
+        elif self._sub_dirty_slots:
+            # Row scatter of explicit host writes only — the device's
+            # last-fan-out values for untouched slots stay authoritative.
+            idx = np.fromiter(
+                self._sub_dirty_slots, np.int32, len(self._sub_dirty_slots)
+            )
+            last, interval, active = self._d_sub_state
+            self._d_sub_state = (
+                last.at[idx].set(self._sub_last[idx]),
+                interval.at[idx].set(self._sub_interval[idx]),
+                active.at[idx].set(self._sub_active[idx]),
+            )
+            self._sub_dirty_slots.clear()
 
     def tick(self, now_ms: Optional[int] = None) -> dict:
         """Run one device decision pass; returns numpy-backed results."""

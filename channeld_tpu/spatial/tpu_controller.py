@@ -42,6 +42,18 @@ class TPUSpatialController(StaticGrid2DSpatialController):
         # Auto-following interests (channeld-tpu extension): conn_id ->
         # (connection, follow_entity_id, kind, extent, direction, angle).
         self._followers: dict[int, tuple] = {}
+        # Device fan-out plane (ref: data.go:175-291 — hot loop #2, now
+        # batched). Due decisions are published into per-channel pending
+        # queues (slot -> engine seq) so each spatial channel consumes
+        # exactly its own due set — O(own due) per tick, and a decision a
+        # channel hasn't consumed yet survives subsequent engine ticks
+        # (the device advances the sub's window unconditionally, so a
+        # dropped bit would silently slip that sub's fan-out a full
+        # interval).
+        self._due_seq = 0
+        self._slot_channel: dict[int, int] = {}
+        self._due_pending: dict[int, dict[int, int]] = {}  # ch_id -> {slot: seq}
+        self._device_sub_count = 0
 
     def load_config(self, config: dict) -> None:
         super().load_config(config)
@@ -117,6 +129,61 @@ class TPUSpatialController(StaticGrid2DSpatialController):
         self._last_positions.pop(entity_id, None)
         self._providers.pop(entity_id, None)
 
+    # ---- device fan-out plane --------------------------------------------
+
+    def device_sub_add(
+        self, interval_ms: int, delay_ms: int, channel_id: int
+    ) -> Optional[int]:
+        """Register a spatial-channel subscription in the engine sub table;
+        None when the engine isn't up or the table is full (the caller
+        falls back to the host time check)."""
+        if self.engine is None:
+            return None
+        try:
+            now = self.engine.now_ms()
+            slot = self.engine.add_subscription(
+                interval_ms, first_due_ms=now + delay_ms
+            )
+        except RuntimeError:
+            return None
+        self._slot_channel[slot] = channel_id
+        self._device_sub_count += 1
+        return slot
+
+    def device_sub_remove(self, slot: int) -> None:
+        if self.engine is not None:
+            self.engine.remove_subscription(slot)
+            ch_id = self._slot_channel.pop(slot, None)
+            if ch_id is not None:
+                self._due_pending.get(ch_id, {}).pop(slot, None)
+            self._device_sub_count -= 1
+
+    def device_sub_set_interval(self, slot: int, interval_ms: int) -> None:
+        if self.engine is not None:
+            self.engine.set_sub_interval(slot, interval_ms)
+
+    def device_sub_first_fanout(self, slot: int) -> None:
+        if self.engine is not None:
+            self.engine.reset_sub_clock(slot, self.engine.now_ms())
+
+    def device_due(self, channel_id: int) -> Optional[tuple[int, dict]]:
+        """(engine_tick_seq, pending {slot: seq}) for one channel; the
+        caller pops entries as it serves them (single consumption). None
+        before the first engine tick (host fallback)."""
+        if self._due_seq == 0:
+            return None
+        return self._due_seq, self._due_pending.setdefault(channel_id, {})
+
+    def _publish_due(self, result) -> None:
+        import numpy as np
+
+        self._due_seq += 1
+        due = np.unpackbits(np.asarray(result["due_packed"]))
+        for slot in np.nonzero(due)[0].tolist():
+            ch_id = self._slot_channel.get(slot)
+            if ch_id is not None:
+                self._due_pending.setdefault(ch_id, {})[slot] = self._due_seq
+
     # ---- auto-following interest (channeld-tpu extension) ----------------
 
     def register_follow_interest(
@@ -174,7 +241,10 @@ class TPUSpatialController(StaticGrid2DSpatialController):
         if self.engine is None:
             return
         self._reap_followers()  # even with no entities tracked
-        if self.engine.entity_count() == 0:
+        # A tick is needed when entities move OR device-registered fan-out
+        # subscriptions exist (due decisions come from the engine even for
+        # an entity-less spatial world, e.g. pure chat-over-spatial).
+        if self.engine.entity_count() == 0 and self._device_sub_count == 0:
             return
         from ..core import metrics
 
@@ -185,6 +255,7 @@ class TPUSpatialController(StaticGrid2DSpatialController):
         handovers = self.engine.handover_list(result)
         metrics.tpu_step_latency.observe(_time.monotonic() - t0)
         metrics.tpu_entities.set(self.engine.entity_count())
+        self._publish_due(result)
         for entity_id, src_cell, dst_cell in handovers:
             self._run_handover(entity_id, src_cell, dst_cell)
         if self._followers:
