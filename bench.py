@@ -56,32 +56,80 @@ def _arm_watchdog(seconds: float) -> None:
     _arm_watchdog.timer = t
 
 
-def _preflight_backend() -> str:
-    """Probe the default backend in a subprocess (a wedged TPU transport
-    hangs inside C and can't be interrupted in-process). Returns
-    "default" when healthy, else "cpu-fallback"."""
+def _probe_once(timeout: float) -> tuple[bool, str]:
+    """One subprocess probe of the default backend: init AND a tiny
+    compile+execute (devices() alone can succeed while compilation is
+    Unavailable on the tunnel). Subprocess because a wedged transport
+    hangs inside C and can't be interrupted in-process."""
     import subprocess
     import sys
 
+    code = (
+        "import jax, jax.numpy as jnp;"
+        "jax.devices();"
+        "print('ok', int((jnp.ones((8,8)) @ jnp.ones((8,8))).sum()))"
+    )
     try:
         probe = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; jax.devices(); print('ok')"],
-            timeout=120, capture_output=True, text=True,
+            [sys.executable, "-c", code],
+            timeout=timeout, capture_output=True, text=True,
         )
-        if probe.returncode == 0 and "ok" in probe.stdout:
-            return "default"
+        if probe.returncode == 0 and "ok 512" in probe.stdout:
+            return True, ""
+        return False, (probe.stderr or probe.stdout).strip()[-300:]
     except subprocess.TimeoutExpired:
-        pass
+        return False, f"probe timed out after {timeout:.0f}s"
+
+
+def _preflight_backend() -> str:
+    """Probe the default backend with retry+backoff: the axon tunnel
+    drops and comes back (observed: 'UNAVAILABLE: TPU backend
+    setup/compile error' for minutes at a time, also init hangs), so a
+    one-shot probe under-reports chip availability. Total budget ~6min
+    before conceding to the CPU fallback."""
+    import os
+    import sys
+
+    forced = os.environ.get("BENCH_BACKEND", "")
+    if forced:  # test/CI override: skip the (slow) retry ladder
+        return "default" if forced == "default" else "cpu-fallback"
+    backoffs = [0, 20, 40, 80, 160]
+    for i, backoff in enumerate(backoffs):
+        if backoff:
+            time.sleep(backoff)
+        ok, err = _probe_once(timeout=120)
+        if ok:
+            return "default"
+        print(f"preflight {i + 1}/{len(backoffs)}: {err}", file=sys.stderr,
+              flush=True)
     return "cpu-fallback"
 
 
 def main() -> None:
+    import os
+    import sys
+
     backend = _preflight_backend()
     if backend == "cpu-fallback":
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    try:
+        _run(backend)
+    except Exception:
+        # Mid-run transport death (tunnel dropped after a healthy
+        # preflight): re-exec once — the fresh preflight retries the chip
+        # with backoff and falls back to CPU if it stays down.
+        if backend == "default" and os.environ.get("BENCH_RETRIED") != "1":
+            print("bench run failed on the chip; re-execing for one retry",
+                  file=sys.stderr, flush=True)
+            env = dict(os.environ, BENCH_RETRIED="1")
+            os.execve(sys.executable,
+                      [sys.executable, os.path.abspath(__file__)], env)
+        raise
+
+
+def _run(backend: str) -> None:
     _arm_watchdog(240.0)
     import jax
     import jax.numpy as jnp
@@ -182,12 +230,25 @@ def main() -> None:
 
     # Pipelined operation: the gateway dispatches tick k+1 before consuming
     # tick k's decisions. Host copies are initiated asynchronously at
-    # dispatch time so consumption never pays the transport round trip;
-    # PIPELINE bounds the consumption lag (sized to hide the tunnel RTT
-    # here; 2-3 suffices on locally attached chips).
+    # dispatch time so consumption never pays the transport round trip.
+    # PIPELINE bounds the consumption lag; autotuned so in-flight work
+    # covers the measured round trip (tunnel RTT can be ~75ms; a locally
+    # attached chip needs only 2-3).
     from collections import deque
 
-    PIPELINE = 32
+    # Dispatch-limited per-step time: a burst with no consumption.
+    burst = 20
+    t0 = time.perf_counter()
+    for _ in range(burst):
+        now += 33
+        positions, velocities, out = move_and_decide(
+            positions, velocities, prev_cell, sub_last, jnp.int32(now)
+        )
+        prev_cell = out["cell_of"]
+        sub_last = out["new_last_fanout_ms"]
+    jax.block_until_ready(out["handover_count"])
+    step_ms = max((time.perf_counter() - t0) / burst * 1000, 0.05)
+    PIPELINE = int(min(64, max(3, blocking_ms / step_ms + 2)))
 
     def trial():
         nonlocal positions, velocities, prev_cell, sub_last, now
@@ -241,6 +302,8 @@ def main() -> None:
         "queries": N_QUERIES,
         "subs": N_SUBS,
         "handovers_per_step": round(handovers_total / max(consumed, 1), 1),
+        "pipeline_depth": PIPELINE,
+        "step_dispatch_ms": round(step_ms, 3),
         "device": str(jax.devices()[0]),
     }
     if backend == "cpu-fallback":

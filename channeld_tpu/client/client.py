@@ -63,11 +63,21 @@ class Client:
             self._ws = ws_client.connect(addr, max_size=1 << 20)
             self._sock = None
         elif addr.startswith(("rudp://", "kcp://")):
-            from ..core.rudp import RudpClient
-
             netloc = urlparse(addr).netloc
             host, _, port = netloc.rpartition(":")
-            self._rudp = RudpClient(host or "127.0.0.1", int(port), connect_timeout)
+            if addr.startswith("kcp://"):
+                # Real KCP wire protocol (kcp-go interop class).
+                from ..core.kcp import KcpClient
+
+                self._rudp = KcpClient(
+                    host or "127.0.0.1", int(port), connect_timeout
+                )
+            else:
+                from ..core.rudp import RudpClient
+
+                self._rudp = RudpClient(
+                    host or "127.0.0.1", int(port), connect_timeout
+                )
             self._ws = None
             self._sock = None
         else:

@@ -178,7 +178,7 @@ async def start_listening(conn_type: ConnectionType, network: str, addr: str):
         server = await websockets.serve(on_ws, host, port, max_size=1 << 20)
         logger.info("listening for %s on ws %s:%d", conn_type.name, host, port)
         return server
-    elif network in ("rudp", "kcp"):
+    elif network == "rudp":
         from .rudp import RudpServerProtocol, RudpSession
 
         class RudpTransport:
@@ -224,6 +224,56 @@ async def start_listening(conn_type: ConnectionType, network: str, addr: str):
             lambda: RudpServerProtocol(on_session), local_addr=(host, port)
         )
         logger.info("listening for %s on rudp %s:%d", conn_type.name, host, port)
+        return protocol
+    elif network == "kcp":
+        from .channel import congestion_wait, connection_congested
+        from .kcp import KcpConn, KcpServerProtocol
+
+        class KcpTransport:
+            def __init__(self, session: KcpConn, addr):
+                self.session = session
+                self.addr = addr
+
+            def write(self, data: bytes) -> None:
+                self.session.send_stream(data)
+
+            def close(self) -> None:
+                self.session.close()
+
+            def remote_addr(self):
+                return self.addr
+
+        def on_session(session: KcpConn, addr) -> None:
+            try:
+                conn = add_connection(KcpTransport(session, addr), conn_type)
+            except ConnectionRefusedError:
+                session.close()
+                return
+
+            def on_stream(seg: bytes) -> None:
+                conn.on_bytes(seg)
+                if connection_congested(conn):
+                    # KCP-native backpressure: pause delivery; the
+                    # advertised receive window shrinks and the peer
+                    # stalls. Resume once the congested channel drains.
+                    session.pause()
+                    asyncio.ensure_future(_resume_when_clear(conn, session))
+
+            session.on_stream = on_stream
+            # Dead link / shed closes the gateway connection like the
+            # TCP/WS reactors (recovery depends on this close event).
+            session.on_close = lambda: conn.close(unexpected=True)
+
+        async def _resume_when_clear(conn, session) -> None:
+            await congestion_wait(conn)
+            if not session.closed:
+                session.resume()
+
+        loop = asyncio.get_running_loop()
+        transport, protocol = await loop.create_datagram_endpoint(
+            lambda: KcpServerProtocol(on_session), local_addr=(host, port)
+        )
+        logger.info("listening for %s on kcp %s:%d", conn_type.name, host, port)
         return protocol
     raise ValueError(f"unsupported network type: {network}")
 

@@ -149,6 +149,12 @@ def release_device_fanout(ch: "Channel", foc: FanOutConnection) -> None:
             ch.device_fallback_focs.remove(foc)
         except ValueError:
             pass
+    # The fan-out queue too: device mode never iterates it, so a dead foc
+    # left behind would sit there for the channel's lifetime.
+    try:
+        ch.fan_out_queue.remove(foc)
+    except ValueError:
+        pass
 
 
 def unsubscribe_from_channel(

@@ -105,7 +105,11 @@ class SpatialEngine:
         self._sub_interval = np.zeros(sub_capacity, np.int32)
         self._sub_active = np.zeros(sub_capacity, bool)
         self._sub_free = list(range(sub_capacity - 1, -1, -1))
-        self._sub_dirty_slots: set[int] = set()
+        # Per-column dirty tracking: interval/active writes must never
+        # drag the stale host `last` along (an interval-only change would
+        # otherwise snap that sub's window start back arbitrarily far).
+        self._sub_dirty_slots: set[int] = set()  # interval + active columns
+        self._sub_last_dirty: set[int] = set()  # last-fan-out column
 
         # Device state (entity arrays sharded over the mesh when given).
         if self._entity_ns is not None:
@@ -270,6 +274,7 @@ class SpatialEngine:
         self._sub_interval[s] = interval_ms
         self._sub_active[s] = True
         self._sub_dirty_slots.add(s)
+        self._sub_last_dirty.add(s)
         return s
 
     def remove_subscription(self, s: int) -> None:
@@ -286,7 +291,7 @@ class SpatialEngine:
         """Snap the sub's window start to ``now`` — mirrors the host path's
         first-fan-out behavior (tick_data sets latest_fanout_time = now)."""
         self._sub_last[s] = now_ms
-        self._sub_dirty_slots.add(s)
+        self._sub_last_dirty.add(s)
 
     # ---- the tick --------------------------------------------------------
 
@@ -346,19 +351,26 @@ class SpatialEngine:
                 jnp.asarray(self._sub_active),
             )
             self._sub_dirty_slots.clear()
-        elif self._sub_dirty_slots:
-            # Row scatter of explicit host writes only — the device's
-            # last-fan-out values for untouched slots stay authoritative.
-            idx = np.fromiter(
-                self._sub_dirty_slots, np.int32, len(self._sub_dirty_slots)
-            )
+            self._sub_last_dirty.clear()
+        elif self._sub_dirty_slots or self._sub_last_dirty:
+            # Per-column row scatters of explicit host writes only — the
+            # device's last-fan-out values for untouched slots stay
+            # authoritative (fanout_due advances them device-side).
             last, interval, active = self._d_sub_state
-            self._d_sub_state = (
-                last.at[idx].set(self._sub_last[idx]),
-                interval.at[idx].set(self._sub_interval[idx]),
-                active.at[idx].set(self._sub_active[idx]),
-            )
-            self._sub_dirty_slots.clear()
+            if self._sub_last_dirty:
+                idx = np.fromiter(
+                    self._sub_last_dirty, np.int32, len(self._sub_last_dirty)
+                )
+                last = last.at[idx].set(self._sub_last[idx])
+                self._sub_last_dirty.clear()
+            if self._sub_dirty_slots:
+                idx = np.fromiter(
+                    self._sub_dirty_slots, np.int32, len(self._sub_dirty_slots)
+                )
+                interval = interval.at[idx].set(self._sub_interval[idx])
+                active = active.at[idx].set(self._sub_active[idx])
+                self._sub_dirty_slots.clear()
+            self._d_sub_state = (last, interval, active)
 
     def tick(self, now_ms: Optional[int] = None) -> dict:
         """Run one device decision pass; returns numpy-backed results."""

@@ -150,6 +150,32 @@ def test_device_slot_freed_on_connection_drop():
     assert not ctl.engine._sub_active[slot]
     assert slot in ctl.engine._sub_free
     assert slot not in ch.device_sub_slots
+    # The fan-out queue entry goes too: device mode never sweeps the
+    # queue, so a leftover foc would leak once per disconnect.
+    assert cs.fanout_conn not in ch.fan_out_queue
+
+
+def test_interval_change_preserves_device_window_start():
+    """Re-subscribing with a new fanOutIntervalMs must not snap the sub's
+    device-side window start back to the stale host mirror."""
+    from channeld_tpu.ops.engine import SpatialEngine
+    from channeld_tpu.ops.spatial_ops import GridSpec
+    import numpy as np
+
+    grid = GridSpec(0.0, 0.0, 100.0, 100.0, 2, 1)
+    eng = SpatialEngine(grid, entity_capacity=16, query_capacity=4,
+                        sub_capacity=8)
+    s = eng.add_subscription(interval_ms=50, first_due_ms=0)
+    for now in (60, 110, 160):  # device last advances to 150
+        out = eng.tick(now_ms=now)
+        assert np.asarray(out["due"])[s]
+    eng.set_sub_interval(s, 100)  # interval-only host write
+    out = eng.tick(now_ms=170)
+    assert not np.asarray(out["due"])[s], (
+        "interval change dragged the stale host last-fan-out along"
+    )
+    out = eng.tick(now_ms=260)  # 150 + 100 = 250 -> due
+    assert np.asarray(out["due"])[s]
 
 
 def test_pending_due_survives_missed_channel_ticks():

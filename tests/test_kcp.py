@@ -1,0 +1,313 @@
+"""KCP wire-protocol transport: golden byte vectors pinned to the KCP
+spec (so compatibility with kcp-go peers is checked against the format
+itself, not our own encoder), ARQ behavior, and gateway E2E.
+
+Ref: the reference accepts KCP clients via kcp-go
+(pkg/channeld/connection.go:207-216, no FEC / no crypt)."""
+
+import struct
+
+import pytest
+
+from channeld_tpu.core.kcp import (
+    CMD_ACK,
+    CMD_PUSH,
+    CMD_WASK,
+    CMD_WINS,
+    DEFAULT_RMT_WND,
+    HEADER_SIZE,
+    MAX_QUEUE_BYTES,
+    RCV_WND,
+    SEG_PAYLOAD,
+    SND_WND,
+    KcpConn,
+    KcpServerProtocol,
+    parse_segments,
+)
+
+
+# ---- wire format golden vectors -------------------------------------------
+
+# Hand-assembled from the KCP header layout (all little-endian):
+# conv=0x11223344 cmd=81 frg=0 wnd=128 ts=1000 sn=5 una=2 len=2 data="hi"
+GOLDEN_PUSH = bytes([
+    0x44, 0x33, 0x22, 0x11,  # conv
+    0x51,                    # cmd = 81 PUSH
+    0x00,                    # frg
+    0x80, 0x00,              # wnd = 128
+    0xE8, 0x03, 0x00, 0x00,  # ts = 1000
+    0x05, 0x00, 0x00, 0x00,  # sn = 5
+    0x02, 0x00, 0x00, 0x00,  # una = 2
+    0x02, 0x00, 0x00, 0x00,  # len = 2
+    0x68, 0x69,              # "hi"
+])
+
+# cmd=82 ACK sn=7 ts=2000 una=8 wnd=64, no payload
+GOLDEN_ACK = bytes([
+    0x44, 0x33, 0x22, 0x11,
+    0x52, 0x00,
+    0x40, 0x00,
+    0xD0, 0x07, 0x00, 0x00,
+    0x07, 0x00, 0x00, 0x00,
+    0x08, 0x00, 0x00, 0x00,
+    0x00, 0x00, 0x00, 0x00,
+])
+
+
+def test_header_is_24_bytes():
+    assert HEADER_SIZE == 24
+
+
+def test_parse_golden_push_segment():
+    segs = list(parse_segments(GOLDEN_PUSH))
+    assert segs == [(0x11223344, CMD_PUSH, 0, 128, 1000, 5, 2, b"hi")]
+
+
+def test_parse_packed_datagram():
+    """kcp coalesces segments per datagram; both must parse."""
+    segs = list(parse_segments(GOLDEN_ACK + GOLDEN_PUSH))
+    assert [s[1] for s in segs] == [CMD_ACK, CMD_PUSH]
+    assert segs[1][7] == b"hi"
+
+
+def test_parse_rejects_hostile_segments():
+    # Truncated payload: len claims beyond the datagram.
+    bad = bytearray(GOLDEN_PUSH)
+    bad[20] = 0xFF
+    assert list(parse_segments(bytes(bad))) == []
+    # Unknown command.
+    bad = bytearray(GOLDEN_PUSH)
+    bad[4] = 0x60
+    assert list(parse_segments(bytes(bad))) == []
+    # Garbage / short datagrams.
+    assert list(parse_segments(b"\x01\x02\x03")) == []
+
+
+def test_emitted_push_matches_wire_layout():
+    """Our encoder produces byte-identical header layout to the spec."""
+    sent = []
+    conn = KcpConn(0x11223344, output=sent.append)
+    conn.send_stream(b"hi")
+    assert len(sent) == 1
+    conv, cmd, frg, wnd, ts, sn, una, length = struct.unpack_from(
+        "<IBBHIIII", sent[0]
+    )
+    assert (conv, cmd, frg, sn, una, length) == (
+        0x11223344, CMD_PUSH, 0, 0, 0, 2)
+    assert wnd == RCV_WND  # empty receive buffer -> full window advertised
+    assert sent[0][HEADER_SIZE:] == b"hi"
+
+
+# ---- ARQ behavior ----------------------------------------------------------
+
+
+def make_pair():
+    """Two KcpConns wired back to back through lossless queues."""
+    a_out, b_out = [], []
+    a = KcpConn(7, output=a_out.append)
+    b = KcpConn(7, output=b_out.append)
+    return a, b, a_out, b_out
+
+
+def pump(a, b, a_out, b_out, rounds=4):
+    for _ in range(rounds):
+        for d in a_out[:]:
+            a_out.remove(d)
+            b.input(d)
+        for d in b_out[:]:
+            b_out.remove(d)
+            a.input(d)
+
+
+def test_stream_roundtrip_and_ack_clears_flight():
+    a, b, a_out, b_out = make_pair()
+    got = []
+    b.on_stream = got.append
+    payload = bytes(range(256)) * 20  # multiple segments
+    a.send_stream(payload)
+    pump(a, b, a_out, b_out)
+    assert b"".join(got) == payload
+    assert a._snd_buf == {}  # fully acked
+    assert a.snd_una == a.snd_nxt
+
+
+def test_out_of_order_delivery_reorders():
+    a, b, a_out, b_out = make_pair()
+    got = []
+    b.on_stream = got.append
+    a.send_stream(b"A" * SEG_PAYLOAD + b"B" * SEG_PAYLOAD + b"C" * 10)
+    # Deliver A's datagrams to B in reverse order.
+    for d in reversed(a_out):
+        b.input(d)
+    assert b"".join(got) == b"A" * SEG_PAYLOAD + b"B" * SEG_PAYLOAD + b"C" * 10
+
+
+def test_retransmit_recovers_loss():
+    a, b, a_out, b_out = make_pair()
+    got = []
+    b.on_stream = got.append
+    a.send_stream(b"X" * SEG_PAYLOAD + b"Y" * SEG_PAYLOAD)
+    # Lose the first datagram entirely.
+    a_out.clear()
+    # Force the retransmit timer and flush.
+    with a._lock:
+        for seg in a._snd_buf.values():
+            seg.resend_at = 0.0
+    a.flush()
+    pump(a, b, a_out, b_out)
+    assert b"".join(got) == b"X" * SEG_PAYLOAD + b"Y" * SEG_PAYLOAD
+
+
+def test_receive_window_bounds_buffer():
+    """Far-future sn must not grow the receive buffer (resource guard)."""
+    conn = KcpConn(1, output=lambda d: None)
+    conn.on_stream = lambda b: None
+    for i in range(100):
+        hostile = struct.pack("<IBBHIIII", 1, CMD_PUSH, 0, 32, 0,
+                              RCV_WND + 1000 + i * 999, 0, 4) + b"evil"
+        conn.input(hostile)
+    assert len(conn._rcv_buf) == 0
+
+
+def test_zero_window_stalls_and_probes():
+    a, b, a_out, b_out = make_pair()
+    # Peer advertises a zero window (e.g. paused receiver).
+    a.input(struct.pack("<IBBHIIII", 7, CMD_WINS, 0, 0, 0, 0, 0, 0))
+    assert a.rmt_wnd == 0
+    a.send_stream(b"Q" * SEG_PAYLOAD)
+    # Nothing in flight; a WASK probe goes out instead.
+    assert a._snd_buf == {}
+    cmds = [s[1] for d in a_out for s in parse_segments(d)]
+    assert CMD_WASK in cmds and CMD_PUSH not in cmds
+    # Window reopens -> data flows.
+    a.input(struct.pack("<IBBHIIII", 7, CMD_WINS, 0, 64, 0, 0, 0, 0))
+    a.flush()
+    cmds = [s[1] for d in a_out for s in parse_segments(d)]
+    assert CMD_PUSH in cmds
+
+
+def test_wask_answered_with_wins():
+    a, b, a_out, b_out = make_pair()
+    b.input(struct.pack("<IBBHIIII", 7, CMD_WASK, 0, 32, 0, 0, 0, 0))
+    cmds = [s[1] for d in b_out for s in parse_segments(d)]
+    assert CMD_WINS in cmds
+
+
+def test_pause_shrinks_advertised_window_and_resume_delivers():
+    a, b, a_out, b_out = make_pair()
+    got = []
+    b.on_stream = got.append
+    b.pause()
+    a.send_stream(b"Z" * SEG_PAYLOAD * 3)
+    pump(a, b, a_out, b_out)
+    assert got == []  # buffered, not delivered
+    assert len(b._rcv_buf) == 3
+    # The acks B sent advertise a shrunken window.
+    wnds = [s[3] for d in b_out for s in parse_segments(d)]
+    b.resume()
+    assert b"".join(got) == b"Z" * SEG_PAYLOAD * 3
+    assert len(b._rcv_buf) == 0
+
+
+def test_black_holed_peer_is_shed():
+    closed = []
+    conn = KcpConn(1, output=lambda d: None)
+    conn.on_close = lambda: closed.append(True)
+    conn.rmt_wnd = 0  # nothing ever leaves the queue
+    chunk = b"q" * SEG_PAYLOAD
+    while not conn.shed:
+        conn.send_stream(chunk)
+    assert closed == [True]
+    assert conn._queue_bytes <= MAX_QUEUE_BYTES + SEG_PAYLOAD
+
+
+def test_server_sessions_keyed_by_source_address():
+    """kcp-go listener semantics: session = source address; a spoofed
+    datagram with the right conv from another address opens an unrelated
+    session instead of touching the victim's."""
+
+    class FakeTransport:
+        def __init__(self):
+            self.sent = []
+
+        def sendto(self, data, addr):
+            self.sent.append((data, addr))
+
+    sessions = []
+    protocol = KcpServerProtocol(on_session=lambda s, a: sessions.append((s, a)))
+    protocol.transport = FakeTransport()
+
+    victim = ("10.0.0.1", 5000)
+    attacker = ("10.6.6.6", 31337)
+    push = struct.pack("<IBBHIIII", 99, CMD_PUSH, 0, 32, 0, 0, 0, 2) + b"ok"
+    protocol.datagram_received(push, victim)
+    assert len(sessions) == 1
+    victim_sess = protocol.sessions[victim]
+    delivered = []
+    victim_sess.on_stream = delivered.append
+
+    evil = struct.pack("<IBBHIIII", 99, CMD_PUSH, 0, 32, 0, 1, 0, 4) + b"evil"
+    protocol.datagram_received(evil, attacker)
+    # Mid-stream sn from an unknown address doesn't even open a session;
+    # the victim's stream is untouched either way.
+    assert protocol.sessions[victim] is victim_sess
+    assert len(sessions) == 1
+    assert delivered == []
+    assert victim_sess.rcv_nxt == 1  # only its own sn=0 "ok" consumed
+
+
+def test_server_ignores_session_flood_without_stream_start():
+    """KCP has no handshake, so a single well-formed datagram could
+    allocate state; only PUSH sn=0 (a conversation's first emission) may
+    open a session, and the table is capped."""
+
+    class FakeTransport:
+        def sendto(self, data, addr):
+            pass
+
+    opened = []
+    protocol = KcpServerProtocol(on_session=lambda s, a: opened.append(a))
+    protocol.transport = FakeTransport()
+    for i in range(500):
+        # Well-formed segments that are NOT a stream start: probes, acks,
+        # mid-stream pushes — from distinct spoofed sources.
+        seg = struct.pack("<IBBHIIII", i + 1, [CMD_ACK, CMD_WASK, CMD_WINS,
+                          CMD_PUSH][i % 4], 0, 32, 0, (i % 4 == 3) and 7 or 0,
+                          0, 0)
+        protocol.datagram_received(seg, ("10.9.%d.%d" % (i // 250, i % 250), 9))
+    assert opened == []
+    assert protocol.sessions == {}
+
+
+def test_receiver_never_acks_above_window():
+    """An acked-but-dropped segment would be a permanent stream gap: the
+    sender stops retransmitting something the receiver never buffered."""
+    sent = []
+    conn = KcpConn(1, output=sent.append)
+    conn.on_stream = lambda b: None
+    above = struct.pack("<IBBHIIII", 1, CMD_PUSH, 0, 32, 0,
+                        RCV_WND + 5, 0, 2) + b"xx"
+    conn.input(above)
+    acks = [s for d in sent for s in parse_segments(d) if s[1] == CMD_ACK]
+    assert acks == []
+    # In-window and duplicate segments ARE acked.
+    ok = struct.pack("<IBBHIIII", 1, CMD_PUSH, 0, 32, 0, 0, 0, 2) + b"ok"
+    conn.input(ok)
+    conn.input(ok)  # duplicate after delivery
+    acks = [s for d in sent for s in parse_segments(d) if s[1] == CMD_ACK]
+    assert [a[5] for a in acks] == [0, 0]
+
+
+def test_gateway_end_to_end_over_kcp():
+    from test_transports import AUTH_FSM, run_gateway_and_client
+    from channeld_tpu.core import connection as connection_mod
+    from channeld_tpu.core.fsm import MessageFsm
+    from channeld_tpu.core.settings import global_settings
+    from helpers import fresh_runtime
+
+    fresh_runtime()
+    global_settings.development = True
+    connection_mod.set_fsm_templates(
+        MessageFsm.from_dict(AUTH_FSM), MessageFsm.from_dict(AUTH_FSM)
+    )
+    run_gateway_and_client("kcp", 23194, "kcp://127.0.0.1:23194")
