@@ -16,12 +16,29 @@ ChatMessage = chat_pb2.ChatMessage
 ChatChannelData = chat_pb2.ChatChannelData
 
 # Messages newer than this always survive a top-truncation (seconds).
+# Module-level so deployments can match the reference examples (chat-rooms
+# main.go sets 60s at boot; merge.go's own default is 10s).
 TIME_SPAN_LIMIT = 10.0
 
 
+def set_time_span_limit(seconds: float) -> None:
+    global TIME_SPAN_LIMIT
+    TIME_SPAN_LIMIT = seconds
+
+
 def _chat_merge(self, src, options, spatial_notifier) -> None:
-    if not isinstance(src, ChatChannelData):
-        raise TypeError("src is not a ChatChannelData")
+    # The same merge serves the chtpu-native family and the
+    # reference-package-compatible one (compat/chatpb.proto). A
+    # cross-family update (same field numbers, different descriptor pool)
+    # is converted via serialize/parse BEFORE any mutation — mutating
+    # first and failing on extend would wipe existing history when
+    # shouldReplaceList is set.
+    if type(src) is not type(self):
+        if not hasattr(src, "chatMessages"):
+            raise TypeError("src is not a chat channel data message")
+        converted = type(self)()
+        converted.ParseFromString(src.SerializeToString())
+        src = converted
     if options is not None and options.shouldReplaceList:
         del self.chatMessages[:]
     self.chatMessages.extend(src.chatMessages)
@@ -42,7 +59,12 @@ def _chat_merge(self, src, options, spatial_notifier) -> None:
             del self.chatMessages[limit:]
 
 
-ChatChannelData.merge = _chat_merge
+def attach_chat_merge(cls) -> None:
+    """Attach the reference chat merge to a ChatChannelData-shaped class."""
+    cls.merge = _chat_merge
+
+
+attach_chat_merge(ChatChannelData)
 
 
 def register_chat_types() -> None:
