@@ -8,7 +8,8 @@ import pytest
 def sidecar():
     from channeld_tpu.ops.service import SpatialDecisionClient, create_server
 
-    server, servicer, port = create_server(port=0)
+    # auth_token="" pins no-auth regardless of CHTPU_SIDECAR_TOKEN in env.
+    server, servicer, port = create_server(port=0, auth_token="")
     server.start()
     client = SpatialDecisionClient(f"127.0.0.1:{port}")
     yield client, servicer
@@ -48,3 +49,116 @@ def test_sidecar_step_roundtrip(sidecar, mesh_devices):
     assert (resp2.handovers[0].entityId, resp2.handovers[0].srcCell,
             resp2.handovers[0].dstCell) == (0x80001, 0, 2)
     assert list(resp2.dueSubIds) == [77]
+
+
+def test_sidecar_delta_interest_and_full_sync(sidecar):
+    """Interest responses are delta (only changed queries); fullInterest
+    resyncs everything — step cost independent of standing queries."""
+    from channeld_tpu.ops.service_pb2 import StepRequest
+
+    client, servicer = sidecar
+    client.configure(
+        worldOffsetX=-150, worldOffsetZ=-150, gridWidth=100, gridHeight=100,
+        gridCols=3, gridRows=3, entityCapacity=64, queryCapacity=8,
+        subCapacity=8,
+    )
+    req = StepRequest(nowMs=10)
+    req.queries.add(connId=5, kind=1, centerX=0, centerZ=0, extentX=40)
+    req.queries.add(connId=6, kind=1, centerX=100, centerZ=100, extentX=40)
+    resp = client.step(req)
+    assert {ir.connId for ir in resp.interests} == {5, 6}
+
+    # No query changes -> no interest rows at all.
+    resp = client.step(StepRequest(nowMs=20))
+    assert len(resp.interests) == 0
+
+    # One query changes -> only that one comes back.
+    req = StepRequest(nowMs=30)
+    req.queries.add(connId=6, kind=1, centerX=-100, centerZ=-100, extentX=40)
+    resp = client.step(req)
+    assert {ir.connId for ir in resp.interests} == {6}
+
+    # Full sync on demand.
+    resp = client.step(StepRequest(nowMs=40, fullInterest=True))
+    assert {ir.connId for ir in resp.interests} == {5, 6}
+
+
+def test_sidecar_step_stream_pipeline(sidecar):
+    from channeld_tpu.ops.service_pb2 import StepRequest
+
+    client, servicer = sidecar
+    client.configure(
+        worldOffsetX=-150, worldOffsetZ=-150, gridWidth=100, gridHeight=100,
+        gridCols=3, gridRows=3, entityCapacity=64, queryCapacity=8,
+        subCapacity=8,
+    )
+
+    def requests():
+        req = StepRequest(nowMs=10)
+        req.updates.add(entityId=0x80001, x=-100, y=0, z=-100)
+        yield req
+        req = StepRequest(nowMs=43)
+        req.updates.add(entityId=0x80001, x=100, y=0, z=-100)  # crossing
+        yield req
+
+    responses = list(client.step_stream(requests()))
+    assert len(responses) == 2
+    assert responses[0].handoverCount == 0
+    assert responses[1].handoverCount == 1
+    assert responses[1].handovers[0].dstCell == 2
+
+
+def test_sidecar_shared_secret_auth():
+    import grpc
+    import pytest as _pytest
+
+    from channeld_tpu.ops.service import SpatialDecisionClient, create_server
+    from channeld_tpu.ops.service_pb2 import StepRequest
+
+    server, servicer, port = create_server(port=0, auth_token="sesame")
+    server.start()
+    try:
+        bad = SpatialDecisionClient(f"127.0.0.1:{port}")
+        with _pytest.raises(grpc.RpcError) as e:
+            bad.configure(gridCols=3, gridRows=3, gridWidth=100,
+                          gridHeight=100)
+        assert e.value.code() == grpc.StatusCode.UNAUTHENTICATED
+        bad.close()
+
+        good = SpatialDecisionClient(f"127.0.0.1:{port}", auth_token="sesame")
+        good.configure(gridCols=3, gridRows=3, gridWidth=100, gridHeight=100,
+                       entityCapacity=16, queryCapacity=4, subCapacity=4)
+        resp = good.step(StepRequest(nowMs=5))
+        assert resp.engineNowMs == 5
+        good.close()
+    finally:
+        server.stop(None)
+
+
+def test_sidecar_stream_survives_malformed_request(sidecar):
+    """A validation error answers in-band on the streaming path; the
+    pipeline and subsequent requests keep working."""
+    from channeld_tpu.ops.service_pb2 import StepRequest
+
+    client, servicer = sidecar
+    client.configure(
+        worldOffsetX=-150, worldOffsetZ=-150, gridWidth=100, gridHeight=100,
+        gridCols=3, gridRows=3, entityCapacity=64, queryCapacity=8,
+        subCapacity=8,
+    )
+
+    def requests():
+        bad = StepRequest(nowMs=10)
+        q = bad.queries.add(connId=9, kind=4)
+        q.spotX.extend([1.0, 2.0])
+        q.spotZ.extend([1.0])  # mismatched -> validation error
+        yield bad
+        good = StepRequest(nowMs=20)
+        good.updates.add(entityId=0x80001, x=0, y=0, z=0)
+        yield good
+
+    responses = list(client.step_stream(requests()))
+    assert len(responses) == 2
+    assert "mismatch" in responses[0].error
+    assert responses[1].error == ""
+    assert sum(responses[1].cellCounts) == 1  # the pipeline kept serving
