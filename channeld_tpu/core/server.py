@@ -145,7 +145,10 @@ async def start_listening(conn_type: ConnectionType, network: str, addr: str):
                 return
             await _reactor(conn, reader)
 
-        server = await asyncio.start_server(on_client, host, port)
+        # Deep accept backlog: a connect storm (10K clients joining after
+        # a match start) must queue, not get RSTs (the reference's
+        # listener inherits Go's somaxconn-sized backlog).
+        server = await asyncio.start_server(on_client, host, port, backlog=4096)
         logger.info("listening for %s on tcp %s:%d", conn_type.name, host, port)
         return server
     elif network in ("ws", "websocket"):

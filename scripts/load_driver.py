@@ -1,0 +1,490 @@
+"""Multi-process gateway load driver: N connections across P worker
+processes pressing one gateway (ref: the reference's replay load-tester,
+pkg/replay/replay.go, and its 10K conns / 100K mps node target,
+README.md:61).
+
+Workers are deliberately dumb and cheap so the measurement presses the
+GATEWAY, not the driver: each connection's steady-state update frame is
+precomputed once (byte-identical sends), inbound traffic is counted by
+scanning 5-byte frame tags without protobuf parsing, and each worker is
+a selector loop — no threads, no per-message Python proto work.
+
+Per-connection flow: connect -> AUTH -> wait for the auth-result frame
+(sending earlier would trip the FSM filter and the anti-DDoS counters)
+-> SUB to GLOBAL with WRITE access -> steady-state chat updates at the
+configured rate.
+
+Run (gateway first, e.g.):
+  python -m channeld_tpu -dev -cn tcp -ca :12108 -sn tcp -sa :11288 \
+      -cwm false -cfsm config/client_authoritative_fsm.json \
+      -imports channeld_tpu.compat
+  python scripts/load_driver.py --addr 127.0.0.1:12108 \
+      --conns 10000 --procs 8 --rate 10 --duration 30
+
+Prints one JSON line of aggregate results; pair with the gateway's
+/metrics (drops, connection_num, fanout latency) for the full picture.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import multiprocessing as mp
+import os
+import selectors
+import socket
+import struct
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+HEADER = 5  # 'C' 'H' szHi szLo ct
+
+
+def _frame(msg_type, body_bytes, channel_id=0):
+    from channeld_tpu.protocol import wire_pb2
+    from channeld_tpu.protocol.framing import encode_packet
+
+    return encode_packet(wire_pb2.Packet(messages=[wire_pb2.MessagePack(
+        channelId=channel_id, msgType=msg_type, msgBody=body_bytes,
+    )]))
+
+
+def _build_frames(conn_index: int, mode: str):
+    """(auth_frame, sub_frame, steady_state_frame) for one connection.
+
+    mode "forward": steady state is an opaque user-space message
+    (msgType 100) routed to the GLOBAL owner — the reference's headline
+    throughput scenario (client messages are NOT parsed by the gateway,
+    connection.go:577-592; its 100K mps node target is this routing
+    path). mode "chat": steady state is a chatpb data update, exercising
+    decode + custom merge per message instead.
+    """
+    from channeld_tpu.compat import chatpb_pb2
+    from channeld_tpu.core.types import ChannelDataAccess, MessageType
+    from channeld_tpu.protocol import control_pb2
+    from channeld_tpu.utils.anyutil import pack_any
+
+    auth = _frame(MessageType.AUTH, control_pb2.AuthMessage(
+        playerIdentifierToken=f"load-{os.getpid()}-{conn_index}",
+        loginToken="load",
+    ).SerializeToString())
+    sub = _frame(
+        MessageType.SUB_TO_CHANNEL,
+        control_pb2.SubscribedToChannelMessage(
+            subOptions=control_pb2.ChannelSubscriptionOptions(
+                dataAccess=ChannelDataAccess.WRITE_ACCESS,
+                fanOutIntervalMs=2000,  # damped: this drives uplink mps
+            ),
+        ).SerializeToString(),
+    )
+    if mode == "forward":
+        steady = _frame(100, b"\x08\x01\x12\x10" + b"p" * 16)  # opaque body
+    else:
+        upd = chatpb_pb2.ChatChannelData()
+        upd.chatMessages.add(sender=f"w{conn_index}", sendTime=1, content="x")
+        steady = _frame(
+            MessageType.CHANNEL_DATA_UPDATE,
+            control_pb2.ChannelDataUpdateMessage(
+                data=pack_any(upd)).SerializeToString(),
+        )
+    return auth, sub, steady
+
+
+def _count_frames(buf: bytearray) -> int:
+    """Consume complete frames from ``buf``; return how many."""
+    count = 0
+    pos = 0
+    n = len(buf)
+    while n - pos >= HEADER:
+        size = (buf[pos + 2] << 8) | buf[pos + 3]
+        if n - pos < HEADER + size:
+            break
+        pos += HEADER + size
+        count += 1
+    del buf[:pos]
+    return count
+
+
+class _Conn:
+    __slots__ = ("sock", "rbuf", "obuf", "authed", "closed", "frames_in",
+                 "blocked", "pending")
+
+    def __init__(self, sock):
+        self.sock = sock
+        self.rbuf = bytearray()
+        self.obuf = bytearray()  # unsent tail after a partial write
+        self.authed = False
+        self.closed = False
+        self.frames_in = 0
+        self.blocked = 0
+        self.pending = ()  # (sub_frame, update_frame)
+
+    def try_send(self, frame: bytes) -> bool:
+        """Frame-atomic non-blocking send: a partial write stashes the
+        unsent TAIL and later sends resume from it — never re-send a
+        whole frame after a partial (that desyncs the tag framing).
+        Returns False on a dead socket."""
+        if self.closed:
+            return False
+        buf = self.obuf
+        if buf:
+            # Flush the backlog first; only then new frames may go out.
+            try:
+                n = self.sock.send(buf)
+                del buf[:n]
+            except (BlockingIOError, InterruptedError):
+                pass
+            except OSError:
+                self.closed = True
+                return False
+            if buf:
+                self.blocked += 1
+                buf.extend(frame)  # keep wire order
+                return True
+        try:
+            n = self.sock.send(frame)
+        except (BlockingIOError, InterruptedError):
+            n = 0
+        except OSError:
+            self.closed = True
+            return False
+        if n < len(frame):
+            self.blocked += 1
+            buf.extend(frame[n:])
+        return True
+
+
+def worker(worker_id: int, addr: str, n_conns: int, rate: float,
+           duration: float, connect_stagger: float, mode: str,
+           result_queue) -> None:
+    """Process entry: a crash must still report (main would otherwise
+    block forever on the result queue)."""
+    try:
+        _worker(worker_id, addr, n_conns, rate, duration, connect_stagger,
+                mode, result_queue)
+    except Exception as e:  # noqa: BLE001 - report, don't hang the bench
+        result_queue.put({
+            "worker": worker_id, "conns": 0, "authed": 0, "sent": 0,
+            "frames_in": 0, "errors": 0, "send_errors": 0, "blocked": 0,
+            "elapsed": duration, "crashed": f"{type(e).__name__}: {e}",
+        })
+
+
+def _worker(worker_id: int, addr: str, n_conns: int, rate: float,
+            duration: float, connect_stagger: float, mode: str,
+            result_queue) -> None:
+    # The gateway must win CPU contention: workers only need to keep the
+    # sockets fed (they send precomputed bytes), so they run maximally
+    # nice'd — essential on small hosts where driver and gateway share
+    # cores.
+    try:
+        os.nice(19)
+    except OSError:
+        pass
+    host, _, port = addr.rpartition(":")
+    host = host or "127.0.0.1"
+    port = int(port)
+
+    sel = selectors.DefaultSelector()
+    conns: list[_Conn] = []
+    errors = 0
+
+    # Phase 1: connect + auth (staggered; the unauth reaper allows
+    # seconds, so a full worker's worth of handshakes fits comfortably).
+    for i in range(n_conns):
+        auth, sub, update = _build_frames(worker_id * 1_000_000 + i, mode)
+        try:
+            s = socket.create_connection((host, port), timeout=10)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            s.sendall(auth)
+        except OSError:
+            errors += 1
+            continue
+        c = _Conn(s)
+        c.pending = (sub, update)  # type: ignore[attr-defined]
+        conns.append(c)
+        s.setblocking(False)
+        sel.register(s, selectors.EVENT_READ, c)
+        if connect_stagger:
+            time.sleep(connect_stagger)
+
+    # Phase 2: collect auth results, then subscribe. Dead connections
+    # shrink the target so one RST can't stall the worker to the deadline.
+    deadline = time.time() + 90
+    authed = 0
+    dead = 0
+    while authed + dead < len(conns) and time.time() < deadline:
+        for key, _ in sel.select(timeout=0.2):
+            c = key.data
+            try:
+                data = c.sock.recv(65536)
+            except (BlockingIOError, InterruptedError):
+                continue
+            except OSError:
+                data = b""
+            if not data:
+                sel.unregister(c.sock)
+                c.closed = True
+                dead += 1
+                errors += 1
+                continue
+            c.rbuf.extend(data)
+            got = _count_frames(c.rbuf)
+            c.frames_in += got
+            if got and not c.authed:
+                c.authed = True
+                authed += 1
+                if not c.try_send(c.pending[0]):  # SUB
+                    errors += 1
+
+    live = [c for c in conns if c.authed and not c.closed]
+
+    # Phase 3: steady state. Every conn sends the precomputed update at
+    # ``rate`` msg/s; inbound frames are drained and counted.
+    sent = 0
+    send_errors = 0
+    t_start = time.time()
+    t_end = t_start + duration
+    interval = 1.0 / rate if rate > 0 else duration
+    next_send = [t_start + interval * (i / max(len(live), 1))
+                 for i in range(len(live))]
+    while True:
+        now = time.time()
+        if now >= t_end:
+            break
+        idle = True
+        for i, c in enumerate(live):
+            if c.closed:
+                continue
+            if now >= next_send[i]:
+                idle = False
+                if c.try_send(c.pending[1]):
+                    sent += 1
+                else:
+                    send_errors += 1  # dead socket, not backpressure
+                next_send[i] += interval
+                if next_send[i] < now - 1.0:  # fell behind: resync
+                    next_send[i] = now + interval
+        if idle:
+            # Nothing due: sleep a beat instead of spinning — the whole
+            # point is to leave the core to the gateway.
+            time.sleep(0.002)
+        for key, _ in sel.select(timeout=0):
+            c = key.data
+            try:
+                data = c.sock.recv(262144)
+            except (BlockingIOError, InterruptedError):
+                continue
+            except OSError:
+                data = b""
+            if not data:
+                # Peer closed: stop selecting AND stop sending to it.
+                sel.unregister(c.sock)
+                c.closed = True
+                continue
+            c.rbuf.extend(data)
+            c.frames_in += _count_frames(c.rbuf)
+    elapsed = time.time() - t_start
+
+    frames_in_total = sum(c.frames_in for c in conns)
+    for c in conns:
+        try:
+            c.sock.close()
+        except OSError:
+            pass
+    result_queue.put({
+        "worker": worker_id,
+        "conns": len(conns),
+        "authed": len(live),
+        "sent": sent,
+        "frames_in": frames_in_total,
+        "errors": errors,
+        "send_errors": send_errors,
+        "blocked": sum(c.blocked for c in conns),
+        "elapsed": elapsed,
+    })
+
+
+def owner_drain(server_addr: str, stop, counters: dict) -> None:
+    """Possess the GLOBAL channel as a server connection and drain the
+    forwarded user-space traffic (the reference's master-server pattern:
+    client messages >= 100 route to the channel owner). Counting is
+    frame-tag scanning only — the owner must not become the bottleneck.
+
+    Failures report via ``counters['owner_error']`` instead of dying
+    silently (forward traffic with no GLOBAL owner measures nothing),
+    and a connection closed by the gateway exits rather than busy-spins
+    (this thread shares the core with the gateway under test)."""
+    from channeld_tpu.core.types import MessageType
+    from channeld_tpu.protocol import control_pb2
+
+    try:
+        host, _, port = server_addr.rpartition(":")
+        s = socket.create_connection(
+            (host or "127.0.0.1", int(port)), timeout=10
+        )
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        s.sendall(_frame(MessageType.AUTH, control_pb2.AuthMessage(
+            playerIdentifierToken="load-owner", loginToken="load",
+        ).SerializeToString()))
+        buf = bytearray()
+        s.settimeout(5)
+        while _count_frames(buf) == 0:
+            data = s.recv(65536)  # auth result
+            if not data:
+                counters["owner_error"] = "gateway closed during owner auth"
+                s.close()
+                return
+            buf.extend(data)
+        s.sendall(_frame(
+            MessageType.CREATE_CHANNEL,
+            control_pb2.CreateChannelMessage(
+                channelType=1,  # GLOBAL: possession (ref: message.go:336-340)
+            ).SerializeToString(),
+        ))
+    except OSError as e:
+        counters["owner_error"] = f"owner setup failed: {e}"
+        return
+    s.settimeout(0.2)
+    frames = 0
+    while not stop.is_set():
+        try:
+            data = s.recv(1 << 20)
+        except socket.timeout:
+            continue
+        except OSError:
+            counters["owner_error"] = "owner connection lost mid-run"
+            break
+        if not data:
+            counters["owner_error"] = "gateway closed the owner mid-run"
+            break
+        buf.extend(data)
+        frames += _count_frames(buf)
+    counters["owner_frames_in"] = frames
+    s.close()
+
+
+def fetch_metrics(port: int = 8080) -> dict:
+    import urllib.request
+
+    try:
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+        ).read().decode()
+    except OSError:
+        return {}
+    out = {}
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        for key in ("messages_in_total", "messages_out_total", "packets_dropped_total",
+                    "connection_num", "fanout_decision_latency_seconds_sum",
+                    "fanout_decision_latency_seconds_count"):
+            if line.startswith(key):
+                name, _, value = line.rpartition(" ")
+                out[name] = out.get(name, 0.0) + float(value)
+    return out
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description="multi-process gateway load driver")
+    p.add_argument("--addr", default="127.0.0.1:12108")
+    p.add_argument("--conns", type=int, default=10_000)
+    p.add_argument("--procs", type=int, default=8)
+    p.add_argument("--rate", type=float, default=10.0,
+                   help="updates per second per connection")
+    p.add_argument("--duration", type=float, default=30.0)
+    p.add_argument("--connect-stagger-ms", type=float, default=0.0)
+    p.add_argument("--metrics-port", type=int, default=8080)
+    p.add_argument("--mode", choices=("forward", "chat"), default="forward",
+                   help="steady-state traffic: opaque user-space routing "
+                        "(the reference's mps scenario) or chat-data merges")
+    p.add_argument("--server-addr", default="127.0.0.1:11288",
+                   help="gateway SERVER listener; forward mode spawns a "
+                        "GLOBAL-owner drain connection there")
+    args = p.parse_args()
+
+    import threading
+
+    stop = threading.Event()
+    owner_counters: dict = {}
+    owner_thread = None
+    if args.mode == "forward":
+        owner_thread = threading.Thread(
+            target=owner_drain, args=(args.server_addr, stop, owner_counters),
+            daemon=True,
+        )
+        owner_thread.start()
+        time.sleep(1.0)  # let the owner possess GLOBAL first
+
+    per_worker = args.conns // args.procs
+    queue: mp.Queue = mp.Queue()
+    metrics_before = fetch_metrics(args.metrics_port)
+    workers = []
+    for w in range(args.procs):
+        n = per_worker + (1 if w < args.conns % args.procs else 0)
+        proc = mp.Process(target=worker, args=(
+            w, args.addr, n, args.rate, args.duration,
+            args.connect_stagger_ms / 1000.0, args.mode, queue,
+        ))
+        proc.start()
+        workers.append(proc)
+    # Bounded waits: a worker that died before reporting must not hang
+    # the bench (workers also self-report crashes, belt and braces).
+    import queue as queue_mod
+
+    results = []
+    result_deadline = time.time() + args.duration + 180
+    for _ in workers:
+        try:
+            results.append(queue.get(timeout=max(result_deadline - time.time(), 1)))
+        except queue_mod.Empty:
+            results.append({"worker": -1, "conns": 0, "authed": 0, "sent": 0,
+                            "frames_in": 0, "errors": 0, "send_errors": 0,
+                            "blocked": 0, "elapsed": args.duration,
+                            "crashed": "no result (worker killed?)"})
+    for proc in workers:
+        proc.join(timeout=10)
+        if proc.is_alive():
+            proc.terminate()
+    metrics_after = fetch_metrics(args.metrics_port)
+    stop.set()
+    if owner_thread is not None:
+        owner_thread.join(timeout=3)
+
+    elapsed = max(r["elapsed"] for r in results)
+    total_sent = sum(r["sent"] for r in results)
+    total_in = sum(r["frames_in"] for r in results)
+    gw_delta = {
+        k: metrics_after.get(k, 0.0) - metrics_before.get(k, 0.0)
+        for k in metrics_after
+        if "connection_num" not in k and "bucket" not in k
+    }
+    crashes = [r["crashed"] for r in results if r.get("crashed")]
+    print(json.dumps({
+        "metric": "gateway_load",
+        "mode": args.mode,
+        "owner_frames_in": owner_counters.get("owner_frames_in", 0),
+        "owner_error": owner_counters.get("owner_error", ""),
+        "worker_crashes": crashes,
+        "conns_requested": args.conns,
+        "conns_authed": sum(r["authed"] for r in results),
+        "procs": args.procs,
+        "rate_per_conn": args.rate,
+        "duration_s": round(elapsed, 1),
+        "driver_sent_mps": round(total_sent / elapsed),
+        "driver_recv_fps": round(total_in / elapsed),
+        "connect_errors": sum(r["errors"] for r in results),
+        "send_errors_dead_socket": sum(r["send_errors"] for r in results),
+        "sends_blocked_backpressure": sum(r.get("blocked", 0) for r in results),
+        "gateway_metrics_delta": {k: round(v) for k, v in sorted(gw_delta.items())},
+        "gateway_connection_num": {
+            k: v for k, v in metrics_after.items() if "connection_num" in k
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()
