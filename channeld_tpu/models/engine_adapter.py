@@ -65,6 +65,8 @@ class RecoverableChannelDataExtension:
 
 def init_message_handlers() -> None:
     """(ref: pkg/unreal/message.go:12-17)."""
+    from ..core import events
+
     register_message_handler(
         MSG_SPAWN, wire_pb2.ServerForwardMessage, handle_spawn_object
     )
@@ -73,6 +75,32 @@ def init_message_handlers() -> None:
     )
     set_channel_data_extension(ChannelType.GLOBAL, RecoverableChannelDataExtension)
     set_channel_data_extension(ChannelType.SUBWORLD, RecoverableChannelDataExtension)
+    events.entity_channel_spatially_owned.listen(
+        handle_entity_channel_spatially_owned
+    )
+
+
+def handle_entity_channel_spatially_owned(data) -> None:
+    """An entity channel just became owned by a spatial server: insert the
+    entity into that spatial channel's entity table, or handover cannot
+    see it (ref: pkg/unreal/message.go:205-215
+    handleEntityChannelSpatiallyOwned)."""
+    entity_data = data.entity_channel.get_data_message()
+    if entity_data is None or not hasattr(entity_data, "state"):
+        logger.error(
+            "spatially-owned entity channel %d has no usable data",
+            data.entity_channel.id,
+        )
+        return
+    state = entity_data.state
+
+    def _add(ch) -> None:
+        data_msg = ch.get_data_message()
+        adder = getattr(data_msg, "add_entity", None)
+        if adder is not None:
+            adder(state.entityId, state)
+
+    data.spatial_channel.execute(_add)
 
 
 def _add_spatial_entity(channel, obj: sim_pb2.ObjectRef, location) -> None:
@@ -195,10 +223,26 @@ def check_entity_handover(
 ) -> tuple[bool, Optional[SpatialInfo], Optional[SpatialInfo]]:
     """Position-delta handover test (ref: pkg/unreal/handover.go:8-47).
 
-    ``swap_yz=True`` applies the UE Z-up -> Y-up axis swap.
+    Axis-presence aware when the locations are sim ``Vec3`` protos: an
+    absent axis in ``new_loc`` falls back to the OLD value (the engine
+    replicated only the axes that changed — exactly the reference's
+    ``newLoc.X != nil`` ladder). ``swap_yz=True`` applies the UE Z-up ->
+    Y-up axis swap.
     """
-    nx, ny, nz = new_loc.x, new_loc.y, new_loc.z
+    def axis(loc, name, fallback):
+        has_field = getattr(loc, "HasField", None)
+        if has_field is not None:
+            try:
+                if not has_field(name):
+                    return fallback
+            except ValueError:
+                pass  # non-optional field: plain read below
+        return getattr(loc, name)
+
     ox, oy, oz = old_loc.x, old_loc.y, old_loc.z
+    nx = axis(new_loc, "x", ox)
+    ny = axis(new_loc, "y", oy)
+    nz = axis(new_loc, "z", oz)
     if (nx, ny, nz) == (ox, oy, oz):
         return False, None, None
     if swap_yz:

@@ -77,21 +77,36 @@ def _entity_get_spatial_info(self) -> Optional[SpatialInfo]:
 
 def _entity_merge(self, src, options, spatial_notifier) -> None:
     """Merge an update and fire the handover notification when the entity
-    crossed a cell boundary (ref: tpspb/data.go:227-320)."""
+    MOVED (ref: tpspb/data.go:227-320 + pkg/unreal/handover.go:8-47):
+    Vec3 axes carry presence, so a partial position update (only the
+    changed axes replicated) merges over the old coordinates instead of
+    zeroing them, and the notification fires only on an actual delta."""
     if not isinstance(src, SimEntityChannelData):
         raise TypeError("src is not a SimEntityChannelData")
     old_info = _position_info(self)
-    new_info = _position_info(src)
     self.MergeFrom(src)
+    # Post-merge position = partial update resolved against old values
+    # (absent axes fell back), exactly CheckEntityHandover's fallback.
+    new_info = _position_info(self)
     if spatial_notifier is None or old_info is None or new_info is None:
         return
     entity_id = self.state.entityId
     if entity_id == 0:
         return
+    provider = lambda src_ch, dst_ch: entity_id
+    if (old_info.x, old_info.y, old_info.z) == (new_info.x, new_info.y, new_info.z):
+        # No movement -> no handover check (handover.go:31). The device
+        # controller still needs to SEE stationary entities (its tracking
+        # and follow-interest centering come from updates), so offer the
+        # observation without the handover path.
+        observe = getattr(spatial_notifier, "observe_entity", None)
+        if observe is not None:
+            observe(entity_id, new_info, provider)
+        return
     spatial_notifier.notify(
         old_info,
         new_info,
-        lambda src_ch, dst_ch: entity_id,
+        provider,
     )
 
 
@@ -109,6 +124,20 @@ def _entity_merge_to(self, spatial_data, full_data: bool) -> None:
 SimEntityChannelData.get_spatial_info = _entity_get_spatial_info
 SimEntityChannelData.merge = _entity_merge
 SimEntityChannelData.merge_to = _entity_merge_to
+
+
+# ---- SimHandoverData: the HandoverDataWithPayload seam --------------------
+
+
+def _handover_clear_payload(self) -> None:
+    """Strip the bulk payload for connections without interest
+    (ref: spatial.go:594-597 HandoverDataWithPayload +
+    unrealpb/extension.go HandoverData.ClearPayload — identity context
+    stays, channel data goes)."""
+    self.ClearField("channelData")
+
+
+sim_pb2.SimHandoverData.clear_payload = _handover_clear_payload
 
 
 def register_sim_types() -> None:

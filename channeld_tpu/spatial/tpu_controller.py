@@ -39,6 +39,11 @@ class TPUSpatialController(StaticGrid2DSpatialController):
         # from the most recent position update (used at batch-detect time).
         self._providers: dict[int, Callable[[int, int], Optional[int]]] = {}
         self._last_positions: dict[int, SpatialInfo] = {}
+        # Position before the latest update — the TRUE old position for
+        # handover orchestration (logic like the reference's position-delta
+        # check, pkg/unreal/handover.go:8-47, needs real coordinates, not
+        # a synthetic cell center).
+        self._prev_positions: dict[int, SpatialInfo] = {}
         # Auto-following interests (channeld-tpu extension): conn_id ->
         # (connection, follow_entity_id, kind, extent, direction, angle).
         self._followers: dict[int, tuple] = {}
@@ -117,8 +122,25 @@ class TPUSpatialController(StaticGrid2DSpatialController):
             except ValueError:
                 pass  # old position outside the world: no baseline
         self.engine.update_entity(entity_id, new_info.x, new_info.y, new_info.z)
+        prev = self._last_positions.get(entity_id)
+        if prev is None and old_info is not None:
+            prev = old_info  # first sighting: the caller's old position
+        if prev is not None:
+            self._prev_positions[entity_id] = prev
         self._last_positions[entity_id] = new_info
         self._providers[entity_id] = handover_data_provider
+
+    def observe_entity(self, entity_id: int, info: SpatialInfo,
+                       handover_data_provider=None) -> None:
+        """Register/update an entity WITHOUT the handover path — fired by
+        entity merges whose position didn't change (the reference never
+        Notifies on an unmoved update, but this controller's tracking and
+        follow-interest centering are fed by updates, so a stationary
+        entity must still be seen)."""
+        self.engine.update_entity(entity_id, info.x, info.y, info.z)
+        self._last_positions.setdefault(entity_id, info)
+        if handover_data_provider is not None:
+            self._providers.setdefault(entity_id, handover_data_provider)
 
     def track_entity(self, entity_id: int, info: SpatialInfo) -> None:
         self.engine.add_entity(entity_id, info.x, info.y, info.z)
@@ -127,6 +149,7 @@ class TPUSpatialController(StaticGrid2DSpatialController):
     def untrack_entity(self, entity_id: int) -> None:
         self.engine.remove_entity(entity_id)
         self._last_positions.pop(entity_id, None)
+        self._prev_positions.pop(entity_id, None)
         self._providers.pop(entity_id, None)
 
     # ---- device fan-out plane --------------------------------------------
@@ -266,10 +289,23 @@ class TPUSpatialController(StaticGrid2DSpatialController):
         provider = self._providers.get(entity_id)
         if provider is None:
             provider = lambda s, d: entity_id
-        old_info = self._cell_center(src_cell)
+        # Use the entity's TRUE previous position when it still maps to the
+        # device-reported src cell (it can diverge when several moves
+        # collapsed into one batched tick); the cell center is only the
+        # consistency fallback. The orchestration recomputes src/dst from
+        # the infos, so whichever is used must map back to src_cell.
+        old_info = self._prev_positions.get(entity_id)
+        if old_info is not None:
+            try:
+                mapped = (self.get_channel_id(old_info)
+                          - global_settings.spatial_channel_id_start)
+            except ValueError:
+                mapped = -1
+            if mapped != src_cell:
+                old_info = None
+        if old_info is None:
+            old_info = self._cell_center(src_cell)
         new_info = self._last_positions.get(entity_id) or self._cell_center(dst_cell)
-        # The parent orchestration recomputes src/dst from the infos; cell
-        # centers map back to exactly src_cell/dst_cell.
         StaticGrid2DSpatialController.notify(self, old_info, new_info, provider)
 
     def _cell_center(self, cell: int) -> SpatialInfo:

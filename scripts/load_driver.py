@@ -436,7 +436,11 @@ def main() -> None:
     import queue as queue_mod
 
     results = []
-    result_deadline = time.time() + args.duration + 180
+    # Budget = steady state + connect stagger (phase 1) + the 90s auth
+    # window (phase 2) + slack; a healthy slow ramp must not be reported
+    # as a crash and terminated mid-run.
+    stagger_budget = per_worker * args.connect_stagger_ms / 1000.0
+    result_deadline = time.time() + args.duration + stagger_budget + 90 + 60
     for _ in workers:
         try:
             results.append(queue.get(timeout=max(result_deadline - time.time(), 1)))
