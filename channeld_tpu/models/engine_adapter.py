@@ -93,12 +93,16 @@ def handle_entity_channel_spatially_owned(data) -> None:
         )
         return
     state = entity_data.state
+    # The entity channel id IS the netId (channel.go:229-241); the data's
+    # state.entityId may legitimately still be unset at this point (it is
+    # filled by the SPAWN path).
+    entity_id = data.entity_channel.id
 
     def _add(ch) -> None:
         data_msg = ch.get_data_message()
         adder = getattr(data_msg, "add_entity", None)
         if adder is not None:
-            adder(state.entityId, state)
+            adder(entity_id, state)
 
     data.spatial_channel.execute(_add)
 

@@ -101,6 +101,117 @@ def assign_and_count_pallas(grid: GridSpec, positions, valid,
     return cell.reshape(n_pad)[:n], jnp.sum(counts, axis=0)[:c]
 
 
+SUB_Q = 8  # queries per grid step (sublane dimension)
+
+
+def _aoi_kernel(grid: GridSpec, c_pad: int, kind_ref, cx_ref, cz_ref,
+                ex_ref, ez_ref, dx_ref, dz_ref, ang_ref, hit_ref, dist_ref):
+    """One tile: SUB_Q queries x all (padded) cells. Cell geometry is
+    generated in-register from iota — nothing but the query SoA tile is
+    read, and the [Q,C] interest/dist planes are written exactly once."""
+    ids = jax.lax.broadcasted_iota(jnp.int32, (SUB_Q, c_pad), 1)
+    col = (ids % grid.cols).astype(jnp.float32)
+    row = (ids // grid.cols).astype(jnp.float32)
+    ccx = grid.offset_x + (col + 0.5) * grid.cell_w
+    ccz = grid.offset_z + (row + 0.5) * grid.cell_h
+    cell_valid = ids < grid.num_cells  # lane padding never hits
+
+    kind = kind_ref[...]  # (SUB_Q, 1) broadcasts along lanes
+    qx, qz = cx_ref[...], cz_ref[...]
+    ex, ez = ex_ref[...], ez_ref[...]
+
+    dx = jnp.abs(qx - ccx)
+    dz = jnp.abs(qz - ccz)
+    half_w = grid.cell_w * 0.5
+    half_h = grid.cell_h * 0.5
+    gap_x = jnp.maximum(dx - half_w, 0.0)
+    gap_z = jnp.maximum(dz - half_h, 0.0)
+    rect_dist = jnp.sqrt(gap_x * gap_x + gap_z * gap_z)
+    center_dist = jnp.sqrt((qx - ccx) ** 2 + (qz - ccz) ** 2)
+
+    radius = ex
+    sphere_hit = rect_dist <= radius
+    box_hit = (dx <= ex + half_w) & (dz <= ez + half_h)
+    to_x = ccx - qx
+    to_z = ccz - qz
+    to_len = jnp.maximum(jnp.sqrt(to_x * to_x + to_z * to_z), 1e-9)
+    cosine = (to_x * dx_ref[...] + to_z * dz_ref[...]) / to_len
+    in_angle = cosine >= jnp.cos(ang_ref[...])
+    apex_cell = rect_dist <= 0.0
+    cone_hit = (rect_dist <= radius) & (in_angle | apex_cell)
+
+    from .spatial_ops import AOI_BOX, AOI_CONE, AOI_SPHERE
+
+    hit = jnp.where(
+        kind == AOI_SPHERE, sphere_hit,
+        jnp.where(kind == AOI_BOX, box_hit,
+                  jnp.where(kind == AOI_CONE, cone_hit, False)),
+    ) & cell_valid
+    dist = jnp.ceil(center_dist / grid.diagonal).astype(jnp.int32)
+    dist = jnp.where(rect_dist <= 0.0, 0, dist)
+    hit_ref[...] = hit.astype(jnp.int32)
+    dist_ref[...] = dist
+
+
+@functools.partial(jax.jit, static_argnums=(0, 2))
+def _aoi_masks_pallas_geom(grid: GridSpec, q_soa, interpret: bool = False):
+    """Geometric AOI pass on device: (hit i32[Q,C_pad], dist i32[Q,C_pad])."""
+    from jax.experimental import pallas as pl
+
+    kind, center, extent, direction, angle = q_soa
+    q = kind.shape[0]
+    q_pad = _cdiv(q, SUB_Q) * SUB_Q
+    c_pad = _cdiv(grid.num_cells, 128) * 128
+
+    def col2d(arr, fill=0):
+        return jnp.pad(arr, (0, q_pad - q), constant_values=fill)[:, None]
+
+    cols = [
+        col2d(kind.astype(jnp.int32)),
+        col2d(center[:, 0]), col2d(center[:, 1]),
+        col2d(extent[:, 0]), col2d(extent[:, 1]),
+        col2d(direction[:, 0]), col2d(direction[:, 1]),
+        col2d(angle),
+    ]
+    tiles = q_pad // SUB_Q
+    hit, dist = pl.pallas_call(
+        functools.partial(_aoi_kernel, grid, c_pad),
+        grid=(tiles,),
+        in_specs=[pl.BlockSpec((SUB_Q, 1), lambda i: (i, 0))] * len(cols),
+        out_specs=[
+            pl.BlockSpec((SUB_Q, c_pad), lambda i: (i, 0)),
+            pl.BlockSpec((SUB_Q, c_pad), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((q_pad, c_pad), jnp.int32),
+            jax.ShapeDtypeStruct((q_pad, c_pad), jnp.int32),
+        ],
+        interpret=interpret,
+    )(*cols)
+    return hit[:q, : grid.num_cells], dist[:q, : grid.num_cells]
+
+
+def aoi_masks_pallas(grid: GridSpec, queries, interpret: bool = False):
+    """Mosaic-fused replacement for spatial_ops.aoi_masks: same results
+    (interest bool[Q,C], dist i32[Q,C]); the spots-table overlay stays in
+    XLA (it is a gather, not geometry)."""
+    hit, dist = _aoi_masks_pallas_geom(
+        grid,
+        (queries.kind, queries.center, queries.extent, queries.direction,
+         queries.angle),
+        interpret,
+    )
+    hit = hit.astype(bool)
+    if queries.spot_dist is not None:
+        from .spatial_ops import AOI_SPOTS
+
+        is_spots = queries.kind[:, None] == AOI_SPOTS
+        spots_hit = queries.spot_dist >= 0
+        hit = jnp.where(is_spots, spots_hit, hit)
+        dist = jnp.where(is_spots & spots_hit, queries.spot_dist, dist)
+    return hit, dist
+
+
 def assign_and_count(grid: GridSpec, positions, valid):
     """Backend-dispatched fused pass: Mosaic on TPU, XLA elsewhere."""
     if pallas_available():

@@ -269,7 +269,7 @@ def spatial_step(
     ``use_pallas`` swaps the assignment+occupancy pass for the fused
     Mosaic kernel (TPU backends only; ~1.7x for that pass)."""
     if use_pallas:
-        from .pallas_kernels import assign_and_count_pallas
+        from .pallas_kernels import aoi_masks_pallas, assign_and_count_pallas
 
         cell_of, counts = assign_and_count_pallas(grid, positions, valid)
     else:
@@ -282,7 +282,10 @@ def spatial_step(
     # Crossings that overflowed the row budget keep their *old* cell as the
     # next tick's baseline, so they are re-detected instead of lost.
     committed_prev = jnp.where(handover_mask & ~reported, prev_cell, cell_of)
-    interest, dist = aoi_masks(grid, queries)
+    if use_pallas:
+        interest, dist = aoi_masks_pallas(grid, queries)
+    else:
+        interest, dist = aoi_masks(grid, queries)
     last_ms, interval_ms, active = sub_state
     due, new_last = fanout_due(now_ms, last_ms, interval_ms, active)
     due_packed = jnp.packbits(due)

@@ -137,7 +137,20 @@ class TPUSpatialController(StaticGrid2DSpatialController):
         Notifies on an unmoved update, but this controller's tracking and
         follow-interest centering are fed by updates, so a stationary
         entity must still be seen)."""
+        first_sighting = entity_id not in self._last_positions
         self.engine.update_entity(entity_id, info.x, info.y, info.z)
+        if first_sighting:
+            # Seed the device baseline cell like notify() does, or a
+            # crossing later in the same tick window would start from
+            # prev_cell=-1 and never be detected.
+            slot = self.engine._slot_of_entity.get(entity_id)
+            if slot is not None:
+                try:
+                    cell = (self.get_channel_id(info)
+                            - global_settings.spatial_channel_id_start)
+                    self.engine.seed_cell(slot, cell)
+                except ValueError:
+                    pass  # outside the world: no baseline
         self._last_positions.setdefault(entity_id, info)
         if handover_data_provider is not None:
             self._providers.setdefault(entity_id, handover_data_provider)

@@ -15,9 +15,13 @@ if "xla_force_host_platform_device_count" not in _flags:
 # Force CPU even though the image pins the axon TPU platform (this harness
 # ignores the JAX_PLATFORMS env var, so use the config API): tests exercise
 # sharding on 8 virtual devices; bench.py uses the real chip.
-from channeld_tpu.utils.devices import pin_cpu_if_virtual_devices  # noqa: E402
+# CHTPU_TEST_TPU=1 skips the pin so the @needs_tpu parity tests
+# (test_pallas.py) can run against the real chip:
+#   CHTPU_TEST_TPU=1 python -m pytest tests/test_pallas.py -k on_device
+if os.environ.get("CHTPU_TEST_TPU") != "1":
+    from channeld_tpu.utils.devices import pin_cpu_if_virtual_devices  # noqa: E402
 
-pin_cpu_if_virtual_devices()
+    pin_cpu_if_virtual_devices()
 
 import pytest  # noqa: E402
 

@@ -360,3 +360,26 @@ def test_stationary_entity_still_observed_by_device_controller():
     info = ctl._last_positions[E + 7]
     assert (info.x, info.z) == (150.0, 50.0)
     assert E + 7 in ctl._providers
+
+
+def test_first_stationary_observation_seeds_handover_baseline():
+    """An entity first seen via an unmoved merge must still have its
+    device baseline cell seeded — a crossing in the same tick window
+    would otherwise start from prev_cell=-1 and never be detected."""
+    from channeld_tpu.core.settings import global_settings
+    from channeld_tpu.spatial.controller import SpatialInfo
+    from channeld_tpu.spatial.tpu_controller import TPUSpatialController
+
+    global_settings.tpu_entity_capacity = 64
+    global_settings.tpu_query_capacity = 8
+    ctl = TPUSpatialController()
+    ctl.load_config(dict(WorldOffsetX=0, WorldOffsetZ=0, GridWidth=100,
+                         GridHeight=100, GridCols=2, GridRows=1, ServerCols=2,
+                         ServerRows=1, ServerInterestBorderSize=1))
+    eid = E + 8
+    ctl.observe_entity(eid, SpatialInfo(40.0, 0.0, 60.0))  # cell 0, no tick yet
+    ctl.notify(SpatialInfo(40.0, 0.0, 60.0), SpatialInfo(170.0, 0.0, 30.0),
+               lambda s, d: eid)  # crossing before the first engine tick
+    result = ctl.engine.tick()
+    crossings = ctl.engine.handover_list(result)
+    assert crossings == [(eid, 0, 1)], crossings
