@@ -178,6 +178,9 @@ class SpatialEngine:
     def entity_count(self) -> int:
         return len(self._slot_of_entity)
 
+    def slot_of_entity(self, entity_id: int) -> Optional[int]:
+        return self._slot_of_entity.get(entity_id)
+
     def entity_id_of_slot(self, slot: int) -> int:
         return int(self._entity_of_slot[slot])
 

@@ -201,15 +201,9 @@ def aoi_masks_pallas(grid: GridSpec, queries, interpret: bool = False):
          queries.angle),
         interpret,
     )
-    hit = hit.astype(bool)
-    if queries.spot_dist is not None:
-        from .spatial_ops import AOI_SPOTS
+    from .spatial_ops import apply_spots_overlay
 
-        is_spots = queries.kind[:, None] == AOI_SPOTS
-        spots_hit = queries.spot_dist >= 0
-        hit = jnp.where(is_spots, spots_hit, hit)
-        dist = jnp.where(is_spots & spots_hit, queries.spot_dist, dist)
-    return hit, dist
+    return apply_spots_overlay(hit.astype(bool), dist, queries)
 
 
 def assign_and_count(grid: GridSpec, positions, valid):

@@ -192,14 +192,20 @@ def aoi_masks(grid: GridSpec, queries: QuerySet):
     dist = jnp.ceil(center_dist / diag).astype(jnp.int32)
     # The query's own cell is distance 0 (ref: result[centerChId] = 0).
     dist = jnp.where(rect_dist <= 0.0, 0, dist)
-    if queries.spot_dist is not None:
-        # Spots: interest and damping distance come straight from the
-        # host-rasterized table (ref: spatial.go spots loop — each spot's
-        # cell with its per-spot dist, default 0; -1 = cell not targeted).
-        is_spots = queries.kind[:, None] == AOI_SPOTS
-        spots_hit = queries.spot_dist >= 0
-        hit = jnp.where(is_spots, spots_hit, hit)
-        dist = jnp.where(is_spots & spots_hit, queries.spot_dist, dist)
+    return apply_spots_overlay(hit, dist, queries)
+
+
+def apply_spots_overlay(hit, dist, queries: QuerySet):
+    """Overlay spots queries' host-rasterized table onto geometric
+    interest/dist planes (ref: spatial.go spots loop — each spot's cell
+    with its per-spot dist, default 0; -1 = cell not targeted). Shared by
+    the XLA and Mosaic AOI paths so spots semantics can never diverge."""
+    if queries.spot_dist is None:
+        return hit, dist
+    is_spots = queries.kind[:, None] == AOI_SPOTS
+    spots_hit = queries.spot_dist >= 0
+    hit = jnp.where(is_spots, spots_hit, hit)
+    dist = jnp.where(is_spots & spots_hit, queries.spot_dist, dist)
     return hit, dist
 
 

@@ -130,6 +130,19 @@ class TPUSpatialController(StaticGrid2DSpatialController):
         self._last_positions[entity_id] = new_info
         self._providers[entity_id] = handover_data_provider
 
+    def _seed_baseline_cell(self, entity_id: int, info: SpatialInfo) -> None:
+        """Set the device prev-cell for a just-sighted entity so a crossing
+        in the same tick window starts from a real baseline, not -1."""
+        slot = self.engine.slot_of_entity(entity_id)
+        if slot is None:
+            return
+        try:
+            cell = (self.get_channel_id(info)
+                    - global_settings.spatial_channel_id_start)
+            self.engine.seed_cell(slot, cell)
+        except ValueError:
+            pass  # outside the world: no baseline
+
     def observe_entity(self, entity_id: int, info: SpatialInfo,
                        handover_data_provider=None) -> None:
         """Register/update an entity WITHOUT the handover path — fired by
@@ -140,17 +153,7 @@ class TPUSpatialController(StaticGrid2DSpatialController):
         first_sighting = entity_id not in self._last_positions
         self.engine.update_entity(entity_id, info.x, info.y, info.z)
         if first_sighting:
-            # Seed the device baseline cell like notify() does, or a
-            # crossing later in the same tick window would start from
-            # prev_cell=-1 and never be detected.
-            slot = self.engine._slot_of_entity.get(entity_id)
-            if slot is not None:
-                try:
-                    cell = (self.get_channel_id(info)
-                            - global_settings.spatial_channel_id_start)
-                    self.engine.seed_cell(slot, cell)
-                except ValueError:
-                    pass  # outside the world: no baseline
+            self._seed_baseline_cell(entity_id, info)
         self._last_positions.setdefault(entity_id, info)
         if handover_data_provider is not None:
             self._providers.setdefault(entity_id, handover_data_provider)
@@ -244,9 +247,25 @@ class TPUSpatialController(StaticGrid2DSpatialController):
             self.engine.remove_query(conn_id)
 
     def _reap_followers(self) -> None:
+        from ..spatial.messages import apply_interest_diff
+
         for conn_id, entry in list(self._followers.items()):
             if entry["conn"].is_closing():
                 self.unregister_follow_interest(conn_id)
+                continue
+            tracked = entry["entity"] in self._last_positions
+            if tracked:
+                entry["seen"] = True
+            elif entry.get("seen"):
+                # The followed entity WAS tracked and is now gone
+                # (destroyed / untracked): a stale frozen center would
+                # stream the wrong cells to the client forever. Drop the
+                # interest entirely — the client re-queries (or
+                # re-follows) on respawn. A follow registered before the
+                # entity's first position update is NOT reaped (grace:
+                # "seen" is only set once the entity appears).
+                self.unregister_follow_interest(conn_id)
+                apply_interest_diff(entry["conn"], {})
 
     def _apply_follow_interests(self, result) -> None:
         from ..spatial.messages import apply_interest_diff

@@ -224,3 +224,52 @@ def test_sub_window_survives_table_churn():
     )
     out = eng.tick(now_ms=110)
     assert np.asarray(out["due"])[s]  # due again at 100 as scheduled
+
+
+def test_follow_interest_reaped_when_entity_destroyed():
+    """(VERDICT r1 weak #7): a follower whose entity was untracked must
+    not keep a stale interest center forever — the follow is dropped and
+    the spatial subscriptions cleared."""
+
+    from channeld_tpu.ops.spatial_ops import AOI_SPHERE
+    from channeld_tpu.spatial.controller import SpatialInfo
+
+    from channeld_tpu.core import connection as connection_mod
+    from channeld_tpu.core.channel import all_channels
+
+    ctl, server = make_tpu_world()
+    eid = 0x80000 + 70
+    ctl.track_entity(eid, SpatialInfo(50.0, 0.0, 50.0))
+    client = StubConnection(9, ConnectionType.CLIENT)
+    connection_mod._all_connections[client.id] = client
+    ctl.register_follow_interest(client, eid, AOI_SPHERE, extent=(80.0, 0.0))
+
+    def run_ticks():
+        ctl.tick()
+        for ch in list(all_channels().values()):
+            ch.tick_once(0)
+
+    run_ticks(); run_ticks()
+    assert client.spatial_subscriptions  # following produced interest
+
+    ctl.untrack_entity(eid)  # entity destroyed
+    run_ticks(); run_ticks()
+    assert client.id not in ctl._followers
+    assert not client.spatial_subscriptions  # interest cleared
+
+
+def test_follow_interest_survives_before_first_entity_update():
+    """A follow registered before the entity's first position update must
+    NOT be reaped (the entity simply hasn't been seen yet)."""
+    from channeld_tpu.ops.spatial_ops import AOI_SPHERE
+    from channeld_tpu.spatial.controller import SpatialInfo
+
+    ctl, server = make_tpu_world()
+    client = StubConnection(9, ConnectionType.CLIENT)
+    eid = 0x80000 + 71
+    ctl.register_follow_interest(client, eid, AOI_SPHERE, extent=(80.0, 0.0))
+    ctl.tick()
+    assert client.id in ctl._followers  # grace: entity not yet seen
+    ctl.track_entity(eid, SpatialInfo(50.0, 0.0, 50.0))
+    ctl.tick()
+    assert client.id in ctl._followers  # now seen and still followed
