@@ -39,11 +39,12 @@ DATA_AXIS = "entities"
 HOST_AXIS = "hosts"
 
 
-def make_mesh(devices: Optional[list] = None) -> Mesh:
+def make_mesh(devices: Optional[list] = None,
+              axis_name: str = DATA_AXIS) -> Mesh:
     import numpy as np
 
     devices = devices if devices is not None else jax.devices()
-    return Mesh(np.array(devices, dtype=object).reshape(-1), (DATA_AXIS,))
+    return Mesh(np.array(devices, dtype=object).reshape(-1), (axis_name,))
 
 
 def make_mesh_2d(n_hosts: int, devices: Optional[list] = None) -> Mesh:

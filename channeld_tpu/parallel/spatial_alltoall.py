@@ -1,0 +1,155 @@
+"""Cell-sharded spatial decision plane: space partitioned over devices.
+
+`parallel/mesh.py` shards the ENTITY axis (every device sees every cell);
+this module shards SPACE itself — each device owns a contiguous block of
+grid rows, exactly like the reference gives each spatial server an
+authority block of cells with a subscribed interest border
+(ref: spatial.go:89-124, :481-590). It is the 2D-world instance of the
+two standard long-context parallelism patterns:
+
+- **all-to-all redistribution** (the Ulysses/sequence-alltoall shape):
+  entities land on whichever shard ingested them; each tick computes
+  their cell, packs them into fixed-capacity per-destination buckets,
+  and one `all_to_all` over ICI delivers every entity (id + position)
+  to the shard that OWNS its cell block. Bucket overflow is never
+  silent: the per-entity ``undelivered`` mask identifies exactly which
+  ingest-shard slots did not fit, so the caller keeps them queued and
+  re-offers them next tick (the same explicit-overflow contract as
+  handover compaction).
+- **ring halo exchange** (the ring-attention shape): per-cell occupancy
+  of the first/last owned grid rows is exchanged with ring neighbors via
+  `ppermute`, giving each shard its interest border — the data the
+  reference's border subscriptions carry between adjacent servers —
+  without any global collective.
+
+Everything is shape-static and jit/shard_map-compatible; tests pin the
+sharded results against the dense single-device computation on the
+virtual 8-device CPU mesh.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.spatial_ops import GridSpec, assign_cells
+
+AXIS = "space"
+
+
+def make_space_mesh(devices=None) -> Mesh:
+    from .mesh import make_mesh
+
+    return make_mesh(devices, axis_name=AXIS)
+
+
+def rows_per_shard(grid: GridSpec, n_shards: int) -> int:
+    if grid.rows % n_shards != 0:
+        raise ValueError(
+            f"grid rows {grid.rows} must divide evenly over {n_shards} shards"
+        )
+    return grid.rows // n_shards
+
+
+def build_cell_sharded_step(grid: GridSpec, mesh: Mesh, bucket: int):
+    """Compile the cell-sharded tick.
+
+    Inputs (sharded over AXIS): positions f32[N,3], valid bool[N],
+    entity_ids i32[N] — N is the per-ingest-shard capacity x n_shards.
+
+    Returns per-shard (all sharded over AXIS, leading dim = n_shards):
+      owned_ids   i32[S, bucket*S]  entity ids now resident on their
+                                    owner shard (-1 = empty slot)
+      owned_cells i32[S, bucket*S]  the owned entities' global cell ids
+      owned_xyz   f32[S, bucket*S, 3]  their positions
+      counts      i32[S, rows_blk*cols]   occupancy of the OWNED block
+      halo_lo     i32[S, cols]  occupancy of the previous shard's LAST
+                                owned row (the south interest border)
+      halo_hi     i32[S, cols]  occupancy of the next shard's FIRST
+                                owned row (the north interest border)
+      undelivered bool[S, n_local]  ingest-shard entity slots whose
+                                destination bucket was full this tick;
+                                the caller re-offers exactly these
+      overflow    i32[S]        sum of undelivered (diagnostic)
+    """
+    n_shards = mesh.devices.size
+    rows_blk = rows_per_shard(grid, n_shards)
+    cells_blk = rows_blk * grid.cols
+
+    def shard_fn(positions, valid, entity_ids):
+        me = jax.lax.axis_index(AXIS)
+        cell_of = assign_cells(grid, positions, valid)  # global cell ids
+        row = cell_of // grid.cols
+        dest = jnp.where(cell_of >= 0, row // rows_blk, -1)  # owner shard
+
+        # Pack per-destination buckets (fixed shape [n_shards, bucket]).
+        # rank within (dest == d) via cumulative counts, like handover
+        # compaction; entities beyond a bucket overflow (reported).
+        slot_ids = jnp.full((n_shards, bucket), -1, jnp.int32)
+        slot_cells = jnp.full((n_shards, bucket), -1, jnp.int32)
+        slot_xyz = jnp.zeros((n_shards, bucket, 3), jnp.float32)
+        delivered = jnp.zeros_like(dest, dtype=bool)
+        for d in range(n_shards):  # static, small (n_shards <= 16)
+            mask = dest == d
+            rank = jnp.cumsum(mask, dtype=jnp.int32) - 1
+            fits = mask & (rank < bucket)
+            delivered = delivered | fits
+            (idx,) = jnp.nonzero(mask, size=bucket, fill_value=0)
+            idx = idx.astype(jnp.int32)
+            row_valid = jnp.arange(bucket) < jnp.sum(fits, dtype=jnp.int32)
+            slot_ids = slot_ids.at[d].set(
+                jnp.where(row_valid, entity_ids[idx], -1))
+            slot_cells = slot_cells.at[d].set(
+                jnp.where(row_valid, cell_of[idx], -1))
+            slot_xyz = slot_xyz.at[d].set(
+                jnp.where(row_valid[:, None], positions[idx], 0.0))
+        undelivered = (dest >= 0) & ~delivered
+        overflow = jnp.sum(undelivered, dtype=jnp.int32)
+
+        # The Ulysses move: [n_shards, bucket] -> every shard receives its
+        # own-destination bucket from every source.
+        recv_ids = jax.lax.all_to_all(slot_ids, AXIS, 0, 0, tiled=False)
+        recv_cells = jax.lax.all_to_all(slot_cells, AXIS, 0, 0, tiled=False)
+        recv_xyz = jax.lax.all_to_all(slot_xyz, AXIS, 0, 0, tiled=False)
+        owned_ids = recv_ids.reshape(-1)  # [n_shards * bucket]
+        owned_cells = recv_cells.reshape(-1)
+        owned_xyz = recv_xyz.reshape(-1, 3)
+
+        # Owned-block occupancy: local cell index = global - block start.
+        block_start = me * cells_blk
+        local = jnp.where(owned_cells >= 0, owned_cells - block_start, 0)
+        present = owned_cells >= 0
+        counts = jnp.zeros(cells_blk, jnp.int32).at[local].add(
+            present.astype(jnp.int32))
+
+        # Ring halo: the interest border. ppermute moves each shard's last
+        # owned row north (to me+1) and first owned row south (to me-1) —
+        # one neighbor hop over ICI, never a global collective.
+        last_row = counts[-grid.cols:]
+        first_row = counts[: grid.cols]
+        halo_lo = jax.lax.ppermute(  # from me-1's last row
+            last_row, AXIS,
+            [(i, (i + 1) % n_shards) for i in range(n_shards)])
+        halo_hi = jax.lax.ppermute(  # from me+1's first row
+            first_row, AXIS,
+            [(i, (i - 1) % n_shards) for i in range(n_shards)])
+        # World edges have no neighbor: zero the wrapped halos.
+        halo_lo = jnp.where(me == 0, jnp.zeros_like(halo_lo), halo_lo)
+        halo_hi = jnp.where(me == n_shards - 1, jnp.zeros_like(halo_hi),
+                            halo_hi)
+        return (owned_ids[None], owned_cells[None], owned_xyz[None],
+                counts[None], halo_lo[None], halo_hi[None],
+                undelivered[None], overflow[None])
+
+    sharded = shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P(AXIS), P(AXIS), P(AXIS)),
+        out_specs=(P(AXIS),) * 8,
+        check_vma=False,
+    )
+    return jax.jit(sharded)
