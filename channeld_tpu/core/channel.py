@@ -164,7 +164,8 @@ class Channel:
                 self.logger.info(
                     "no channel data template registered; first update sets the data"
                 )
-        self.data = ChannelData(data_msg, merge_options)
+        self.data = ChannelData(data_msg, merge_options,
+                                channel_type=self.channel_type)
         initializer = getattr(data_msg, "init_data", None)
         if callable(initializer):
             initializer()

@@ -121,14 +121,25 @@ class FanOutConnection:
     device_sub_slot: Optional[int] = None
 
 
+class IncompatibleUpdateError(TypeError):
+    """An update's message type doesn't match the channel's data type.
+    Family merges raise this (not bare TypeError) so the drop guard can't
+    swallow genuine programming TypeErrors from inside merge logic."""
+
+
 class ChannelData:
     def __init__(
         self,
         msg: Optional[Message],
         merge_options: Optional[control_pb2.ChannelDataMergeOptions] = None,
+        channel_type: Optional[int] = None,
     ):
         self.msg = msg
         self.merge_options = merge_options
+        # For late-binding adoption checks (first update sets the data):
+        # if a data type gets registered for this channel type, an
+        # adopting update must match it.
+        self.channel_type = channel_type
         self.update_msg_buffer: list[UpdateBufferElement] = []
         self.accumulated_update_msg: Optional[Message] = (
             type(msg)() if msg is not None else None
@@ -148,13 +159,35 @@ class ChannelData:
         """(ref: data.go:149-173). ``now_ns`` optionally bounds stray
         arrival stamps to the channel's own clock."""
         if self.msg is None:
+            # Adoption (channeld-tpu extension; the reference drops updates
+            # until data is initialized): only write-access subscribers
+            # reach here, and if a data type IS registered for this
+            # channel type by now, the adopting update must match it — a
+            # single mistyped update must not wedge the channel forever.
+            if self.channel_type is not None:
+                expected = reflect_channel_data_message(self.channel_type)
+                if expected is not None and type(expected) is not type(update_msg):
+                    logger.warning(
+                        "refusing to initialize channel data with %s "
+                        "(registered type is %s)",
+                        type(update_msg).DESCRIPTOR.full_name,
+                        type(expected).DESCRIPTOR.full_name,
+                    )
+                    return
             self.msg = update_msg
             logger.info(
                 "initialized channel data with update message from conn %d",
                 sender_conn_id,
             )
         else:
-            merge_with_options(self.msg, update_msg, self.merge_options, spatial_notifier)
+            merged = merge_with_options(
+                self.msg, update_msg, self.merge_options, spatial_notifier
+            )
+            if not merged:
+                # Dropped (incompatible type): it must not enter the
+                # update ring either — a buffered wrong-type message would
+                # fan out verbatim or crash window accumulation later.
+                return
         self.msg_index += 1
         # The fan-out windowing bisects this buffer, which requires arrival
         # times to be monotonic in this channel's clock. Clamp stray stamps
@@ -407,8 +440,13 @@ def merge_with_options(
     src: Message,
     options: Optional[control_pb2.ChannelDataMergeOptions],
     spatial_notifier=None,
-) -> None:
-    """(ref: data.go:326-347)."""
+) -> bool:
+    """(ref: data.go:326-347). Returns False when the update was DROPPED
+    as type-incompatible (the caller must then keep it out of the update
+    ring); True otherwise. The reference's reflection merge would panic
+    the channel goroutine on mismatched descriptors; here it is a clean
+    warning drop — one line, not a stack trace, or a hostile client
+    could flood the log."""
     merge = getattr(dst, "merge", None)
     if callable(merge):
         if options is None:
@@ -417,10 +455,21 @@ def merge_with_options(
             )
         try:
             merge(src, options, spatial_notifier)
+        except IncompatibleUpdateError as e:
+            logger.warning("dropping incompatible update: %s", e)
+            return False
         except Exception:
+            # Genuine merge bugs keep their stack traces.
             logger.exception("custom merge error")
     else:
+        if type(dst) is not type(src):
+            logger.warning(
+                "dropping update of type %s: channel data is %s",
+                type(src).DESCRIPTOR.full_name, type(dst).DESCRIPTOR.full_name,
+            )
+            return False
         reflect_merge(dst, src, options)
+    return True
 
 
 def reflect_merge(

@@ -10,6 +10,8 @@ from __future__ import annotations
 
 import time
 
+from ..core.data import IncompatibleUpdateError
+
 from . import chat_pb2
 
 ChatMessage = chat_pb2.ChatMessage
@@ -35,7 +37,7 @@ def _chat_merge(self, src, options, spatial_notifier) -> None:
     # shouldReplaceList is set.
     if type(src) is not type(self):
         if not hasattr(src, "chatMessages"):
-            raise TypeError("src is not a chat channel data message")
+            raise IncompatibleUpdateError("src is not a chat channel data message")
         converted = type(self)()
         converted.ParseFromString(src.SerializeToString())
         src = converted

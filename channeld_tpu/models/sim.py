@@ -13,6 +13,7 @@ from __future__ import annotations
 from typing import Optional
 
 from ..spatial.controller import SpatialInfo
+from ..core.data import IncompatibleUpdateError
 from ..utils.logger import get_logger
 from . import sim_pb2
 
@@ -34,7 +35,8 @@ def _spatial_add_entity(self, entity_id: int, entity_data) -> None:
     elif isinstance(entity_data, EntityState):
         self.entities[entity_id].CopyFrom(entity_data)
     else:
-        raise TypeError(f"cannot add entity from {type(entity_data).__name__}")
+        raise IncompatibleUpdateError(
+            f"cannot add entity from {type(entity_data).__name__}")
     self.entities[entity_id].entityId = entity_id
 
 
@@ -47,7 +49,7 @@ def _spatial_merge(self, src, options, spatial_notifier) -> None:
     """Entity-table merge: update/insert by id, honoring removed flags
     (ref: unrealpb/extension.go SpatialChannelData.Merge)."""
     if not isinstance(src, SimSpatialChannelData):
-        raise TypeError("src is not a SimSpatialChannelData")
+        raise IncompatibleUpdateError("src is not a SimSpatialChannelData")
     for entity_id, state in src.entities.items():
         if state.removed:
             self.entities.pop(entity_id, None)
@@ -82,7 +84,7 @@ def _entity_merge(self, src, options, spatial_notifier) -> None:
     changed axes replicated) merges over the old coordinates instead of
     zeroing them, and the notification fires only on an actual delta."""
     if not isinstance(src, SimEntityChannelData):
-        raise TypeError("src is not a SimEntityChannelData")
+        raise IncompatibleUpdateError("src is not a SimEntityChannelData")
     old_info = _position_info(self)
     self.MergeFrom(src)
     # Post-merge position = partial update resolved against old values
@@ -113,7 +115,7 @@ def _entity_merge(self, src, options, spatial_notifier) -> None:
 def _entity_merge_to(self, spatial_data, full_data: bool) -> None:
     """(ref: tpspb/data.go MergeTo). Identifier-only unless ``full_data``."""
     if not isinstance(spatial_data, SimSpatialChannelData):
-        raise TypeError("target is not a SimSpatialChannelData")
+        raise IncompatibleUpdateError("target is not a SimSpatialChannelData")
     entity_id = self.state.entityId
     if full_data:
         spatial_data.entities[entity_id].CopyFrom(self.state)

@@ -316,3 +316,63 @@ def test_merge_sub_options_on_resubscribe():
     assert not access_changed
     assert (cs.options.dataAccess, cs.options.fanOutIntervalMs,
             cs.options.fanOutDelayMs) == (1, 20, 200)
+
+
+def test_cross_type_update_dropped_cleanly():
+    """A client shipping a data type the channel doesn't speak must not
+    traceback-spam the log or corrupt state — clean warning drop (the
+    reference's reflection merge would panic the channel goroutine)."""
+    from channeld_tpu.core.data import ChannelData
+    from channeld_tpu.models import sim_pb2
+    from channeld_tpu.models.sim import register_sim_types  # noqa: F401
+
+    data = ChannelData(sim_pb2.SimGlobalChannelData())
+    data.msg.kv["k"] = "v"
+    hostile = sim_pb2.SimSpatialChannelData()
+    hostile.entities[1].SetInParent()
+    data.on_update(hostile, 0, 1, None)  # must not raise
+    assert data.msg.kv["k"] == "v"  # state intact
+    assert type(data.msg) is sim_pb2.SimGlobalChannelData
+    # Custom-merge path (spatial data) rejects cross-type cleanly too.
+    spatial = ChannelData(sim_pb2.SimSpatialChannelData())
+    spatial.msg.entities[5].SetInParent()
+    data2 = sim_pb2.SimGlobalChannelData()
+    spatial.on_update(data2, 0, 1, None)  # must not raise
+    assert 5 in spatial.msg.entities
+
+
+def test_dropped_cross_type_update_never_enters_the_ring():
+    """A dropped incompatible update must not be buffered either — it
+    would fan out verbatim or crash window accumulation later."""
+    from channeld_tpu.core.data import ChannelData
+    from channeld_tpu.models import sim_pb2
+    import channeld_tpu.models.sim  # noqa: F401  (attaches merges)
+
+    data = ChannelData(sim_pb2.SimGlobalChannelData())
+    before = len(data.update_msg_buffer)
+    data.on_update(sim_pb2.SimSpatialChannelData(), 0, 1, None)
+    assert len(data.update_msg_buffer) == before
+    assert data.msg_index == 0
+
+
+def test_hostile_first_update_cannot_wedge_a_registered_channel():
+    """Late-binding adoption: once a data type is registered for the
+    channel type, a mistyped first update is refused (it would otherwise
+    fix the wrong type forever, warn-dropping all legit updates)."""
+    from channeld_tpu.core.channel import ChannelType
+    from channeld_tpu.core.data import (
+        ChannelData,
+        register_channel_data_type,
+    )
+    from channeld_tpu.models import sim_pb2
+    import channeld_tpu.models.sim  # noqa: F401
+
+    register_channel_data_type(ChannelType.GLOBAL, sim_pb2.SimGlobalChannelData())
+    data = ChannelData(None, channel_type=ChannelType.GLOBAL)
+    hostile = sim_pb2.SimSpatialChannelData()
+    data.on_update(hostile, 0, 666, None)
+    assert data.msg is None  # refused
+    good = sim_pb2.SimGlobalChannelData()
+    good.kv["k"] = "v"
+    data.on_update(good, 0, 1, None)
+    assert data.msg is good  # legit adoption proceeds
