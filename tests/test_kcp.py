@@ -311,3 +311,66 @@ def test_gateway_end_to_end_over_kcp():
         MessageFsm.from_dict(AUTH_FSM), MessageFsm.from_dict(AUTH_FSM)
     )
     run_gateway_and_client("kcp", 23194, "kcp://127.0.0.1:23194")
+
+
+def test_stream_integrity_over_adversarial_link():
+    """Stochastic link torture: drop, duplicate, and reorder datagrams in
+    both directions; the byte stream must still arrive complete, in
+    order (retransmit timers forced instead of waiting out real RTOs).
+    Corruption is a separate test: KCP without FEC/CRC — the reference's
+    kcp-go configuration — cannot detect payload bit-flips; the protobuf
+    layer above rejects them."""
+    import random
+
+    rng = random.Random(1234)
+
+    class Link:
+        def __init__(self):
+            self.queue = []  # in-flight datagrams
+
+        def send(self, dgram):
+            r = rng.random()
+            if r < 0.15:
+                return  # dropped
+            self.queue.append(bytearray(dgram))
+            if r < 0.25:
+                self.queue.append(bytearray(dgram))  # duplicated
+            if r < 0.40 and len(self.queue) > 1:
+                i = rng.randrange(len(self.queue))
+                self.queue[i], self.queue[-1] = self.queue[-1], self.queue[i]
+
+        def deliver(self, target):
+            q, self.queue = self.queue, []
+            for d in q:
+                target.input(bytes(d))
+
+    ab, ba = Link(), Link()
+    a = KcpConn(9, output=ab.send)
+    b = KcpConn(9, output=ba.send)
+    got = bytearray()
+    b.on_stream = got.extend
+
+    payload = bytes(rng.randrange(256) for _ in range(SEG_PAYLOAD * 40))
+    sent_off = 0
+    for round_i in range(400):
+        if sent_off < len(payload):
+            chunk = payload[sent_off : sent_off + SEG_PAYLOAD * 2]
+            a.send_stream(chunk)
+            sent_off += len(chunk)
+        ab.deliver(b)
+        ba.deliver(a)
+        # Force retransmission timers instead of sleeping out RTOs.
+        with a._lock:
+            for seg in a._snd_buf.values():
+                seg.resend_at = 0.0
+        a.flush()
+        b.flush()
+        if bytes(got) == payload:
+            break
+    assert bytes(got) == payload, (
+        f"stream corrupted/incomplete: {len(got)}/{len(payload)} bytes"
+    )
+    # Note: corruption resilience here relies on header sanity checks
+    # (cmd whitelist, length bound); like kcp-go without FEC/CRC, a flip
+    # confined to payload bytes would pass through — the layer above
+    # (protobuf parse) rejects it, matching the reference's stack.
