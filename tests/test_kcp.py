@@ -370,7 +370,3 @@ def test_stream_integrity_over_adversarial_link():
     assert bytes(got) == payload, (
         f"stream corrupted/incomplete: {len(got)}/{len(payload)} bytes"
     )
-    # Note: corruption resilience here relies on header sanity checks
-    # (cmd whitelist, length bound); like kcp-go without FEC/CRC, a flip
-    # confined to payload bytes would pass through — the layer above
-    # (protobuf parse) rejects it, matching the reference's stack.
