@@ -246,11 +246,17 @@ class KcpConn:
         """Feed one received datagram (possibly several packed segments)."""
         if self.closed:
             return
+        # Validate conv across the WHOLE datagram before touching any
+        # state: a mid-datagram conv mismatch must drop the datagram
+        # wholesale, not strand payloads that earlier iterations already
+        # dequeued (rcv_nxt would advance past them, so retransmits
+        # arrive as duplicates and the bytes are lost forever).
+        segments = list(parse_segments(data))
+        if any(seg[0] != self.conv for seg in segments):
+            return  # whole datagram suspect; no state applied
         deliver: list[bytes] = []
         with self._lock:
-            for conv, cmd, frg, wnd, ts, sn, una, payload in parse_segments(data):
-                if conv != self.conv:
-                    return  # whole datagram suspect
+            for conv, cmd, frg, wnd, ts, sn, una, payload in segments:
                 self.rmt_wnd = wnd
                 # Cumulative ack: everything below una is delivered.
                 if una > self.snd_una:
@@ -294,6 +300,17 @@ class KcpConn:
         while not self.paused and self.rcv_nxt in self._rcv_buf:
             deliver.append(self._rcv_buf.pop(self.rcv_nxt))
             self.rcv_nxt = (self.rcv_nxt + 1) & 0xFFFFFFFF
+
+    def keepalive(self) -> None:
+        """Emit a lone WASK probe. Costs one 24-byte datagram; the server
+        counts it as inbound traffic, so a quiet-but-alive client is not
+        idle-reaped (after which its mid-stream sn>0 PUSHes would be
+        silently dropped — a new session requires PUSH sn 0)."""
+        if self.closed:
+            return
+        with self._lock:
+            seg = self._pack(CMD_WASK, self._now_ms(), 0)
+        self._emit([seg])
 
     # -- backpressure ------------------------------------------------------
 
@@ -341,6 +358,7 @@ class KcpConn:
 
 
 IDLE_TIMEOUT = 30.0  # reap sessions with no inbound traffic (dead peers)
+KEEPALIVE_INTERVAL = 10.0  # client probes well inside IDLE_TIMEOUT
 MAX_SESSIONS = 4096  # spoofed-source flood ceiling
 
 
@@ -423,10 +441,15 @@ class KcpClient:
         self._sock.connect((host, port))
         self._sock.settimeout(timeout)
         self.conv = secrets.randbits(32) or 1
-        self.session = KcpConn(self.conv, self._sock.send)
+        self._last_tx = time.monotonic()
+        self.session = KcpConn(self.conv, self._tx)
         self._recv_buffer = bytearray()
         self._recv_lock = threading.Lock()
         self.session.on_stream = self._on_stream
+
+    def _tx(self, data: bytes) -> None:
+        self._last_tx = time.monotonic()
+        self._sock.send(data)
 
     def _on_stream(self, seg: bytes) -> None:
         with self._recv_lock:
@@ -438,13 +461,35 @@ class KcpClient:
         except OSError:
             self.session.closed = True
 
+    def _maybe_keepalive(self) -> None:
+        if time.monotonic() - self._last_tx > KEEPALIVE_INTERVAL:
+            self.session.keepalive()
+
     def recv(self, timeout: float = 0.0) -> bytes:
-        self._sock.settimeout(timeout if timeout > 0 else 0.000001)
+        deadline = time.monotonic() + max(timeout, 0.0)
         try:
+            # Wait for the first datagram in keepalive-bounded slices: a
+            # single long quiet recv() must not outlast the server's
+            # idle reaper (IDLE_TIMEOUT) just because the probe check
+            # only ran between calls.
             while True:
-                data = self._sock.recv(65536)
-                self.session.input(data)
-                self._sock.settimeout(0.000001)
+                self._maybe_keepalive()
+                now = time.monotonic()
+                wait = min(max(deadline - now, 0.0),
+                           max(self._last_tx + KEEPALIVE_INTERVAL - now,
+                               0.05))
+                self._sock.settimeout(wait if wait > 0 else 0.000001)
+                try:
+                    data = self._sock.recv(65536)
+                    break
+                except socket.timeout:
+                    if time.monotonic() >= deadline:
+                        raise
+            self.session.input(data)
+            # Drain whatever else is queued without blocking.
+            self._sock.settimeout(0.000001)
+            while True:
+                self.session.input(self._sock.recv(65536))
         except (socket.timeout, BlockingIOError):
             pass
         except OSError:
@@ -452,6 +497,7 @@ class KcpClient:
             return b""
         try:
             self.session.flush()
+            self._maybe_keepalive()
         except OSError:
             self.session.closed = True
         with self._recv_lock:

@@ -11,7 +11,10 @@ Serving properties:
 - Interest results are DELTA: AOI masks depend only on query geometry,
   so only connections whose query changed this step are recomputed and
   returned (request fullInterest for a complete sync). Step cost is
-  therefore independent of the standing query population.
+  therefore independent of the standing query population. Dirty
+  tracking is per caller (per stream / per unary peer), so concurrent
+  gateway clients each see every change exactly once; a caller's first
+  step is automatically a full sync.
 - Steps serialize per engine (not on a global lock): a long device step
   never blocks Configure, and an engine swap never waits on traffic to
   a doomed engine.
@@ -27,6 +30,7 @@ from __future__ import annotations
 import os
 import threading
 import time
+import uuid
 from concurrent import futures
 from typing import Optional
 
@@ -45,22 +49,79 @@ logger = get_logger("ops.service")
 
 SERVICE_NAME = "chtpu.ops.SpatialDecision"
 AUTH_METADATA_KEY = "x-chtpu-auth"
+# Distinguishes unary callers for delta-interest tracking. context.peer()
+# alone is NOT enough: grpc-python shares subchannels between channels
+# with the same target+args, so two client objects in one process can
+# present the same peer address.
+CALLER_METADATA_KEY = "x-chtpu-caller"
 
 
 class _StepValidationError(ValueError):
     """A malformed StepRequest; unary aborts, streaming reports in-band."""
 
 
+_DIRTY_CALLER_TTL = 300.0  # forget unary peers silent this long
+_MAX_DIRTY_CALLERS = 64  # hard cap: caller ids are client-controlled
+
+
 class _EngineState:
     """One engine plus ALL its serving state, swapped atomically on
     Configure: a step racing a swap holds the doomed state's lock and
-    touches only that state — never the new engine's dirty set/sub map."""
+    touches only that state — never the new engine's dirty set/sub map.
+
+    Dirty-interest tracking is PER CALLER (one set per stream, one per
+    unary peer): a query mutation marks the conn dirty in every caller's
+    set, and each caller's step drains only its own — so a unary Step
+    racing a StepStream (or two gateway clients) can't consume each
+    other's pending delta-interest notifications. A caller seen for the
+    first time starts with every standing query dirty, so its first step
+    is a full sync without needing fullInterest."""
 
     def __init__(self, engine):
         self.engine = engine
         self.lock = threading.Lock()
         self.sub_map: dict[int, int] = {}
-        self.dirty_interest: set[int] = set()
+        self._dirty_sets: dict[object, set[int]] = {}
+        self._dirty_seen: dict[object, float] = {}
+        self._pinned: set[object] = set()  # stream callers: no TTL/evict
+
+    def dirty_for(self, caller: object, pinned: bool = False) -> set[int]:
+        """The caller's own dirty set (created on first use). The
+        registry is bounded two ways — caller ids are client-controlled
+        metadata, so it must not grow with hostile or buggy traffic:
+        unary peers unseen within the TTL are pruned, and at the hard
+        cap the longest-unseen unary peer is evicted (it full-resyncs on
+        return). ``pinned`` callers (open streams) are exempt from both;
+        stream teardown drops them explicitly."""
+        now = time.monotonic()
+        dirty = self._dirty_sets.get(caller)
+        if dirty is None:
+            unpinned = [k for k in self._dirty_seen if k not in self._pinned]
+            if len(unpinned) >= _MAX_DIRTY_CALLERS:
+                self.drop_caller(min(unpinned, key=self._dirty_seen.get))
+            dirty = set(self.engine._q_of_conn.keys())
+            self._dirty_sets[caller] = dirty
+            if pinned:
+                self._pinned.add(caller)
+        self._dirty_seen[caller] = now
+        for stale in [k for k, t in self._dirty_seen.items()
+                      if now - t > _DIRTY_CALLER_TTL
+                      and k not in self._pinned]:
+            self.drop_caller(stale)
+        return dirty
+
+    def drop_caller(self, caller: object) -> None:
+        self._dirty_sets.pop(caller, None)
+        self._dirty_seen.pop(caller, None)
+        self._pinned.discard(caller)
+
+    def mark_dirty(self, conn_id: int) -> None:
+        for dirty in self._dirty_sets.values():
+            dirty.add(conn_id)
+
+    def unmark_dirty(self, conn_id: int) -> None:
+        for dirty in self._dirty_sets.values():
+            dirty.discard(conn_id)
 
 
 class SpatialDecisionServicer:
@@ -144,9 +205,14 @@ class SpatialDecisionServicer:
     def step(self, request: StepRequest, context) -> StepResponse:
         self._check_auth(context)
         state = self._current_state(context)
+        # One dirty set per unary caller: the x-chtpu-caller metadata if
+        # the gateway sends one, else the peer address. TTL-pruned in
+        # _EngineState.dirty_for.
+        meta = dict(context.invocation_metadata() or ())
+        caller = ("unary", meta.get(CALLER_METADATA_KEY) or context.peer())
         try:
             with state.lock:
-                return self._do_step(state, request)
+                return self._do_step(state, request, caller)
         except _StepValidationError as e:
             import grpc
 
@@ -157,19 +223,33 @@ class SpatialDecisionServicer:
         malformed request answers in-band (StepResponse.error) instead of
         killing the pipeline with its in-flight steps."""
         self._check_auth(context)
-        for request in request_iterator:
-            state = self._current_state(context)
-            try:
+        caller = object()  # one dirty set per stream, dropped at stream end
+        state = None
+        try:
+            for request in request_iterator:
+                state = self._current_state(context)
+                try:
+                    # Yield OUTSIDE the lock: a generator suspends at
+                    # yield, and a stalled stream consumer must not hold
+                    # the engine lock against unary steps/other streams.
+                    with state.lock:
+                        resp = self._do_step(state, request, caller,
+                                             pinned=True)
+                except _StepValidationError as e:
+                    resp = StepResponse(engineNowMs=request.nowMs,
+                                        error=str(e))
+                yield resp
+        finally:
+            if state is not None:
                 with state.lock:
-                    yield self._do_step(state, request)
-            except _StepValidationError as e:
-                yield StepResponse(engineNowMs=request.nowMs, error=str(e))
+                    state.drop_caller(caller)
 
     # ---- the decision pass -------------------------------------------
 
-    def _do_step(self, state: _EngineState, request: StepRequest) -> StepResponse:
+    def _do_step(self, state: _EngineState, request: StepRequest,
+                 caller: object, pinned: bool = False) -> StepResponse:
         eng = state.engine
-        dirty = state.dirty_interest
+        dirty = state.dirty_for(caller, pinned=pinned)
         for up in request.updates:
             eng.update_entity(up.entityId, up.x, up.y, up.z)
         for eid in request.removedEntityIds:
@@ -184,7 +264,7 @@ class SpatialDecisionServicer:
                 eng.set_spots_query(
                     q.connId, list(zip(q.spotX, q.spotZ)), list(q.spotDists)
                 )
-                dirty.add(q.connId)
+                state.mark_dirty(q.connId)
                 continue
             direction = (q.dirX, q.dirZ)
             if direction == (0.0, 0.0):
@@ -193,10 +273,10 @@ class SpatialDecisionServicer:
                 q.connId, q.kind, (q.centerX, q.centerZ),
                 (q.extentX, q.extentZ), direction, q.angle,
             )
-            dirty.add(q.connId)
+            state.mark_dirty(q.connId)
         for conn_id in request.removedQueryConnIds:
             eng.remove_query(conn_id)
-            dirty.discard(conn_id)
+            state.unmark_dirty(conn_id)
         sub_map = state.sub_map
         for sub in request.addSubscriptions:
             sub_map[sub.subId] = eng.add_subscription(
@@ -289,10 +369,12 @@ class SpatialDecisionClient:
                  auth_token: Optional[str] = None):
         import grpc
 
+        self.target = target
         self._channel = grpc.insecure_channel(target)
-        self._metadata = (
-            ((AUTH_METADATA_KEY, auth_token),) if auth_token else None
-        )
+        meta = [(CALLER_METADATA_KEY, uuid.uuid4().hex)]
+        if auth_token:
+            meta.append((AUTH_METADATA_KEY, auth_token))
+        self._metadata = tuple(meta)
         self._configure = self._channel.unary_unary(
             f"/{SERVICE_NAME}/Configure",
             request_serializer=ConfigRequest.SerializeToString,

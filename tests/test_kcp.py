@@ -298,6 +298,86 @@ def test_receiver_never_acks_above_window():
     assert [a[5] for a in acks] == [0, 0]
 
 
+def test_conv_mismatch_drops_whole_datagram_without_state_change():
+    """A packed datagram whose LATER segment carries a wrong conv must be
+    dropped wholesale BEFORE any state is applied: if earlier in-order
+    payloads were already dequeued and then discarded, rcv_nxt has moved
+    past them, retransmits look like duplicates, and those bytes are
+    lost forever — desyncing the tag framing above."""
+    sent = []
+    conn = KcpConn(7, output=sent.append)
+    got = []
+    conn.on_stream = got.append
+
+    good = struct.pack("<IBBHIIII", 7, CMD_PUSH, 0, 32, 0, 0, 0, 2) + b"ok"
+    evil = struct.pack("<IBBHIIII", 8, CMD_PUSH, 0, 32, 0, 1, 0, 2) + b"no"
+    conn.input(good + evil)
+    # Nothing consumed, nothing acked, window position unchanged.
+    assert got == []
+    assert conn.rcv_nxt == 0
+    assert conn._rcv_buf == {}
+    assert [s for d in sent for s in parse_segments(d)] == []
+    # The sender's retransmit of the same segment (clean datagram this
+    # time) is NOT a duplicate: it delivers.
+    conn.input(good)
+    assert got == [b"ok"]
+    assert conn.rcv_nxt == 1
+
+
+def test_keepalive_probe_refreshes_server_idle_timer():
+    """A quiet-but-alive client would otherwise be idle-reaped, after
+    which its mid-stream sn>0 PUSHes are dropped forever (new sessions
+    require PUSH sn=0). keepalive() emits a WASK the server counts as
+    inbound traffic."""
+    sent = []
+    conn = KcpConn(7, output=sent.append)
+    conn.keepalive()
+    segs = [s for d in sent for s in parse_segments(d)]
+    assert [s[1] for s in segs] == [CMD_WASK]
+
+    class FakeTransport:
+        def sendto(self, data, addr):
+            pass
+
+    protocol = KcpServerProtocol(on_session=lambda s, a: None)
+    protocol.transport = FakeTransport()
+    addr = ("10.0.0.1", 5000)
+    start = struct.pack("<IBBHIIII", 7, CMD_PUSH, 0, 32, 0, 0, 0, 2) + b"ok"
+    protocol.datagram_received(start, addr)
+    protocol._last_input[addr] = 1.0  # pretend the session went quiet
+    protocol.datagram_received(sent[0], addr)  # the keepalive WASK
+    assert protocol._last_input[addr] > 1.0  # reap timer refreshed
+
+
+def test_keepalive_fires_inside_long_blocking_recv(monkeypatch):
+    """A single quiet recv(timeout >= IDLE_TIMEOUT) must still probe:
+    the blocking wait is sliced at the keepalive cadence, otherwise the
+    server reaps the session before the first WASK ever leaves."""
+    import socket as socket_mod
+
+    from channeld_tpu.core import kcp as kcp_mod
+
+    monkeypatch.setattr(kcp_mod, "KEEPALIVE_INTERVAL", 0.08)
+    server = socket_mod.socket(socket_mod.AF_INET, socket_mod.SOCK_DGRAM)
+    server.bind(("127.0.0.1", 0))
+    server.settimeout(0.01)
+    client = kcp_mod.KcpClient("127.0.0.1", server.getsockname()[1])
+    try:
+        client._last_tx = 0.0  # pretend the last send was long ago
+        client.recv(timeout=0.3)  # one quiet blocking call
+        probes = []
+        try:
+            while True:
+                probes.extend(s[1] for s in
+                              parse_segments(server.recv(65536)))
+        except socket_mod.timeout:
+            pass
+        assert probes.count(CMD_WASK) >= 2  # fired DURING the wait
+    finally:
+        client.close()
+        server.close()
+
+
 def test_gateway_end_to_end_over_kcp():
     from test_transports import AUTH_FSM, run_gateway_and_client
     from channeld_tpu.core import connection as connection_mod

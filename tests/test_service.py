@@ -83,6 +83,72 @@ def test_sidecar_delta_interest_and_full_sync(sidecar):
     assert {ir.connId for ir in resp.interests} == {5, 6}
 
 
+def test_sidecar_dirty_interest_is_per_caller(sidecar):
+    """A second gateway client must not have its pending delta-interest
+    notifications consumed by the first caller's step: each caller has
+    its own dirty set, and a caller's first step is a full sync."""
+    from channeld_tpu.ops.service import SpatialDecisionClient
+    from channeld_tpu.ops.service_pb2 import StepRequest
+
+    client, servicer = sidecar
+    client.configure(
+        worldOffsetX=-150, worldOffsetZ=-150, gridWidth=100, gridHeight=100,
+        gridCols=3, gridRows=3, entityCapacity=64, queryCapacity=8,
+        subCapacity=8,
+    )
+    req = StepRequest(nowMs=10)
+    req.queries.add(connId=5, kind=1, centerX=0, centerZ=0, extentX=40)
+    assert {ir.connId for ir in client.step(req).interests} == {5}
+
+    # A second client (its own channel -> its own peer identity): first
+    # contact reports the standing query even though client 1 already
+    # drained its own delta.
+    port = client.target.rsplit(":", 1)[1]
+    other = SpatialDecisionClient(f"127.0.0.1:{port}")
+    try:
+        assert {ir.connId for ir in
+                other.step(StepRequest(nowMs=20)).interests} == {5}
+        # A change via client 1 reaches BOTH callers exactly once.
+        req = StepRequest(nowMs=30)
+        req.queries.add(connId=5, kind=1, centerX=100, centerZ=100,
+                        extentX=40)
+        assert {ir.connId for ir in client.step(req).interests} == {5}
+        assert {ir.connId for ir in
+                other.step(StepRequest(nowMs=40)).interests} == {5}
+        # ...and only once: both drained now.
+        assert len(client.step(StepRequest(nowMs=50)).interests) == 0
+        assert len(other.step(StepRequest(nowMs=60)).interests) == 0
+    finally:
+        other.close()
+
+
+def test_sidecar_dirty_caller_registry_is_bounded(sidecar):
+    """Caller ids are client-controlled metadata: the registry must hold
+    at the hard cap (longest-unseen unary caller evicted), not grow with
+    hostile or buggy per-request caller churn."""
+    from channeld_tpu.ops import service as service_mod
+    from channeld_tpu.ops.service_pb2 import StepRequest
+
+    client, servicer = sidecar
+    client.configure(
+        worldOffsetX=-150, worldOffsetZ=-150, gridWidth=100, gridHeight=100,
+        gridCols=3, gridRows=3, entityCapacity=64, queryCapacity=8,
+        subCapacity=8,
+    )
+    client.step(StepRequest(nowMs=1))
+    state = servicer._state
+    with state.lock:
+        for i in range(service_mod._MAX_DIRTY_CALLERS * 3):
+            state.dirty_for(("unary", f"churn-{i}"))
+        pinned = state.dirty_for(("stream", "open"), pinned=True)
+        for i in range(service_mod._MAX_DIRTY_CALLERS * 3,
+                       service_mod._MAX_DIRTY_CALLERS * 6):
+            state.dirty_for(("unary", f"churn-{i}"))
+        assert len(state._dirty_sets) <= service_mod._MAX_DIRTY_CALLERS + 1
+        # The pinned (stream) caller survived the churn.
+        assert state._dirty_sets[("stream", "open")] is pinned
+
+
 def test_sidecar_step_stream_pipeline(sidecar):
     from channeld_tpu.ops.service_pb2 import StepRequest
 
