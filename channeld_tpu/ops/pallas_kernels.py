@@ -44,9 +44,11 @@ def _assign_count_kernel(grid: GridSpec, c_pad: int, x_ref, z_ref, valid_ref,
     z = z_ref[...]
     gx = jnp.floor((x - grid.offset_x) / grid.cell_w).astype(jnp.int32)
     gz = jnp.floor((z - grid.offset_z) / grid.cell_h).astype(jnp.int32)
+    # valid arrives as i32: a bool (i8-stored) input would need an i8->i1
+    # vector truncation Mosaic can't lower on v5e.
     inside = (
         (gx >= 0) & (gx < grid.cols) & (gz >= 0) & (gz < grid.rows)
-        & valid_ref[...]
+        & (valid_ref[...] != 0)
     )
     cell = jnp.where(inside, gx + gz * grid.cols, -1)
     cell_ref[...] = cell
@@ -76,7 +78,7 @@ def assign_and_count_pallas(grid: GridSpec, positions, valid,
 
     x = jnp.pad(positions[:, 0], (0, n_pad - n), constant_values=jnp.inf)
     z = jnp.pad(positions[:, 2], (0, n_pad - n), constant_values=jnp.inf)
-    v = jnp.pad(valid, (0, n_pad - n), constant_values=False)
+    v = jnp.pad(valid.astype(jnp.int32), (0, n_pad - n), constant_values=0)
     tiles = n_pad // TILE
     shape = (tiles * SUBLANES, LANES)
 
@@ -142,10 +144,12 @@ def _aoi_kernel(grid: GridSpec, c_pad: int, kind_ref, cx_ref, cz_ref,
 
     from .spatial_ops import AOI_BOX, AOI_CONE, AOI_SPHERE
 
-    hit = jnp.where(
-        kind == AOI_SPHERE, sphere_hit,
-        jnp.where(kind == AOI_BOX, box_hit,
-                  jnp.where(kind == AOI_CONE, cone_hit, False)),
+    # Pure i1 mask algebra: a where-chain with a Python bool arm lowers to
+    # an i8 constant vector + i8->i1 truncation Mosaic can't compile.
+    hit = (
+        ((kind == AOI_SPHERE) & sphere_hit)
+        | ((kind == AOI_BOX) & box_hit)
+        | ((kind == AOI_CONE) & cone_hit)
     ) & cell_valid
     dist = jnp.ceil(center_dist / grid.diagonal).astype(jnp.int32)
     dist = jnp.where(rect_dist <= 0.0, 0, dist)

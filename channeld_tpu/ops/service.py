@@ -96,9 +96,14 @@ class _EngineState:
         now = time.monotonic()
         dirty = self._dirty_sets.get(caller)
         if dirty is None:
-            unpinned = [k for k in self._dirty_seen if k not in self._pinned]
-            if len(unpinned) >= _MAX_DIRTY_CALLERS:
-                self.drop_caller(min(unpinned, key=self._dirty_seen.get))
+            # Only unpinned callers count toward (and make room in) the
+            # cap: a new pinned stream must not evict a unary caller's
+            # pending deltas to claim a slot it is itself exempt from.
+            if not pinned:
+                unpinned = [k for k in self._dirty_seen
+                            if k not in self._pinned]
+                if len(unpinned) >= _MAX_DIRTY_CALLERS:
+                    self.drop_caller(min(unpinned, key=self._dirty_seen.get))
             dirty = set(self.engine._q_of_conn.keys())
             self._dirty_sets[caller] = dirty
             if pinned:
