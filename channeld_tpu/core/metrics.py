@@ -73,6 +73,18 @@ tpu_step_latency = Histogram(
     registry=registry,
 )
 tpu_entities = Gauge("tpu_entities", "Entities resident on device", registry=registry)
+tpu_cell_overflow = Gauge(
+    "tpu_cell_overflow",
+    "Entities whose cells-plane redistribution bucket was full last tick "
+    "(re-offered next tick)",
+    registry=registry,
+)
+tpu_capacity_shed = Counter(
+    "tpu_capacity_shed",
+    "Device-plane registrations shed to the host path at capacity",
+    ["table"],
+    registry=registry,
+)
 
 
 def serve_metrics(port: int = 8080) -> None:

@@ -44,6 +44,8 @@ class SpatialEngine:
         sub_capacity: int = 1 << 16,
         max_handovers: int = 4096,
         mesh=None,
+        sharding: str = "entities",
+        cell_bucket: int = 0,
     ):
         """``mesh``: a jax.sharding.Mesh to shard the entity slot arrays
         over (from parallel.mesh.make_mesh / make_mesh_2d). None = the
@@ -51,9 +53,23 @@ class SpatialEngine:
         way (pinned by tests/test_ops.py engine parity); the mesh step
         exchanges per-cell occupancy with psum over ICI/DCN and gathers
         per-shard handover rows — the TPU answer to the reference's
-        multi-server spatial world (ref: spatial.go:387-590)."""
+        multi-server spatial world (ref: spatial.go:387-590).
+
+        ``sharding`` picks the meshed step: "entities" (psum occupancy,
+        replicated AOI) or "cells" (space-partitioned: all_to_all entity
+        redistribution to per-shard cell blocks + column-block AOI +
+        ring-halo borders — parallel/spatial_alltoall.py). "cells" with
+        ``cell_bucket`` > 0 caps the per-(source, dest) redistribution
+        bucket; overflowed entities are reported undelivered and
+        re-offered next tick (0 = exact delivery)."""
+        if sharding not in ("entities", "cells"):
+            raise ValueError(f"unknown sharding {sharding!r}")
         self._mesh = mesh
+        self._sharding = sharding
+        self._cell_bucket = cell_bucket
         self._mesh_step = None
+        # Cells-plane shed diagnostics, refreshed each mesh tick.
+        self.last_overflow = 0
         if mesh is not None:
             n_dev = int(mesh.devices.size)
             # Entity arrays shard evenly over every mesh axis.
@@ -375,6 +391,17 @@ class SpatialEngine:
                 self._sub_dirty_slots.clear()
             self._d_sub_state = (last, interval, active)
 
+    def warmup(self) -> None:
+        """Compile the tick's common (no-spots) step on empty tables —
+        called at controller load, BEFORE listeners open. Without this the
+        first live tick pays multi-second XLA compilation inside the
+        channel tick, stalling the event loop long enough for the unauth
+        reaper to blacklist slow-authing peers (observed end-to-end with
+        the meshed cells plane). The warmup tick mutates nothing the
+        serving path reads: tables are empty and inactive."""
+        self.tick(now_ms=0)
+        self.last_result = None
+
     def tick(self, now_ms: Optional[int] = None) -> dict:
         """Run one device decision pass; returns numpy-backed results."""
         if now_ms is None:
@@ -408,34 +435,65 @@ class SpatialEngine:
     def _mesh_tick(self, now_ms: int) -> dict:
         """The sharded decision pass, normalized to the single-device
         result contract (handover_count + merged global-slot rows)."""
-        from ..parallel.mesh import (
-            build_sharded_step,
-            merge_handover_shards,
-            sharded_spatial_step,
-        )
+        from ..parallel.mesh import merge_handover_shards
 
         with_spots = self._d_queries.spot_dist is not None
         if self._mesh_step is None or self._mesh_step.with_spots != with_spots:
             n_shards = int(self._mesh.devices.size)
             per_shard = max(1, -(-self.max_handovers // n_shards))
-            self._mesh_step = build_sharded_step(
-                self.grid, self._mesh, per_shard, with_spots
+            if self._sharding == "cells":
+                from ..parallel.spatial_alltoall import (
+                    build_cell_serving_step,
+                )
+
+                bucket = self._cell_bucket or (
+                    self.entity_capacity // n_shards
+                )
+                self._mesh_step = build_cell_serving_step(
+                    self.grid, self._mesh, bucket, per_shard, with_spots
+                )
+            else:
+                from ..parallel.mesh import build_sharded_step
+
+                self._mesh_step = build_sharded_step(
+                    self.grid, self._mesh, per_shard, with_spots
+                )
+        if self._sharding == "cells":
+            from ..parallel.spatial_alltoall import cell_serving_spatial_step
+
+            out = cell_serving_spatial_step(
+                self._mesh_step, self._d_positions, self._d_cell,
+                self._d_valid, self._d_queries, self._d_sub_state, now_ms,
             )
-        out = sharded_spatial_step(
-            self._mesh_step,
-            self._d_positions,
-            self._d_cell,
-            self._d_valid,
-            self._d_queries,
-            self._d_sub_state,
-            now_ms,
-        )
+            self.last_overflow = int(np.asarray(out["overflow"]).sum())
+        else:
+            from ..parallel.mesh import sharded_spatial_step
+
+            out = sharded_spatial_step(
+                self._mesh_step,
+                self._d_positions,
+                self._d_cell,
+                self._d_valid,
+                self._d_queries,
+                self._d_sub_state,
+                now_ms,
+            )
         count, rows = merge_handover_shards(
             out["handover_counts"], out["handovers"]
         )
         out["handover_count"] = count
         out["handovers"] = rows
         return out
+
+    def undelivered_slots(self, result: dict) -> list[int]:
+        """Slots whose cells-plane redistribution bucket was full this
+        tick (empty for exact delivery / other shardings). They remain in
+        the ingest arrays and are re-offered automatically next tick;
+        the controller sheds visibly (metric + security log)."""
+        und = result.get("undelivered")
+        if und is None:
+            return []
+        return np.nonzero(np.asarray(und))[0].tolist()
 
     def handover_list(self, result: dict) -> list[tuple[int, int, int]]:
         """[(entity_id, src_cell, dst_cell)] from a tick result.

@@ -138,61 +138,69 @@ class QuerySet(NamedTuple):
     spot_dist: Optional[jnp.ndarray] = None  # i32[Q,C]; -1 = no interest
 
 
-def _cell_geometry(grid: GridSpec):
-    """Centers f32[C,2] and half-sizes of every cell."""
-    c = jnp.arange(grid.num_cells, dtype=jnp.int32)
-    cx = grid.offset_x + (c % grid.cols + 0.5) * grid.cell_w
-    cz = grid.offset_z + (c // grid.cols + 0.5) * grid.cell_h
-    return jnp.stack([cx, cz], axis=1)
-
-
 def aoi_masks(grid: GridSpec, queries: QuerySet):
     """Interest of every query in every cell.
 
     Returns (interest bool[Q,C], dist i32[Q,C]) where dist is the
     ceil(center-to-sample / cell-diagonal) damping distance, matching the
-    host path's metric (ref: spatial.go:182-317).
-    """
-    centers = _cell_geometry(grid)  # [C,2]
+    host path's metric (ref: spatial.go:182-317). One source of truth:
+    the full-grid case of aoi_masks_for_cells (the cell-sharded plane
+    calls it per block)."""
+    return aoi_masks_for_cells(
+        grid, queries, jnp.arange(grid.num_cells, dtype=jnp.int32),
+        queries.spot_dist,
+    )
+
+
+def aoi_masks_for_cells(grid: GridSpec, queries: QuerySet, cell_ids,
+                        spot_dist_slice=None):
+    """``aoi_masks`` for an arbitrary i32[Cb] vector of GLOBAL cell ids —
+    the cell-sharded plane computes only its owned block's columns and
+    all_gathers the rest (parallel/spatial_alltoall.py). ``cell_ids`` may
+    be traced (block starts depend on axis_index). Ids outside
+    [0, num_cells) are padding: never interested. ``spot_dist_slice`` is
+    the [Q, Cb] slice of the spots table for these cells (None = no spots
+    queries registered). Parity with aoi_masks is pinned by
+    tests/test_spatial_alltoall.py."""
+    col = (cell_ids % grid.cols).astype(jnp.float32)
+    row = (cell_ids // grid.cols).astype(jnp.float32)
+    centers = jnp.stack(
+        [grid.offset_x + (col + 0.5) * grid.cell_w,
+         grid.offset_z + (row + 0.5) * grid.cell_h], axis=1)  # [Cb,2]
+    cell_valid = (cell_ids >= 0) & (cell_ids < grid.num_cells)
     half = jnp.array([grid.cell_w * 0.5, grid.cell_h * 0.5])
 
-    # Distance from each query center to each cell rectangle (clamped).
-    delta = jnp.abs(queries.center[:, None, :] - centers[None, :, :])  # [Q,C,2]
+    delta = jnp.abs(queries.center[:, None, :] - centers[None, :, :])
     gap = jnp.maximum(delta - half[None, None, :], 0.0)
-    rect_dist = jnp.sqrt(jnp.sum(gap * gap, axis=-1))  # [Q,C]
-    center_dist = jnp.sqrt(jnp.sum((queries.center[:, None, :] - centers) ** 2, axis=-1))
+    rect_dist = jnp.sqrt(jnp.sum(gap * gap, axis=-1))
+    center_dist = jnp.sqrt(
+        jnp.sum((queries.center[:, None, :] - centers) ** 2, axis=-1))
 
-    radius = queries.extent[:, 0:1]  # [Q,1]
-
-    # Sphere: shape overlaps the cell rect.
+    radius = queries.extent[:, 0:1]
     sphere_hit = rect_dist <= radius
-
-    # Box: axis-aligned overlap test.
-    box_hit = jnp.all(delta <= (queries.extent[:, None, :] + half[None, None, :]), axis=-1)
-
-    # Cone: within radius AND the cell center direction within the half-angle
-    # (cell containing the apex always hits).
-    to_cell = centers[None, :, :] - queries.center[:, None, :]  # [Q,C,2]
+    box_hit = jnp.all(
+        delta <= (queries.extent[:, None, :] + half[None, None, :]), axis=-1)
+    to_cell = centers[None, :, :] - queries.center[:, None, :]
     to_len = jnp.maximum(jnp.sqrt(jnp.sum(to_cell * to_cell, axis=-1)), 1e-9)
     cosine = jnp.sum(to_cell * queries.direction[:, None, :], axis=-1) / to_len
     in_angle = cosine >= jnp.cos(queries.angle)[:, None]
     apex_cell = rect_dist <= 0.0
     cone_hit = (rect_dist <= radius) & (in_angle | apex_cell)
 
-    hit = jnp.where(
-        queries.kind[:, None] == AOI_SPHERE,
-        sphere_hit,
-        jnp.where(
-            queries.kind[:, None] == AOI_BOX,
-            box_hit,
-            jnp.where(queries.kind[:, None] == AOI_CONE, cone_hit, False),
-        ),
-    )
-    diag = grid.diagonal
-    dist = jnp.ceil(center_dist / diag).astype(jnp.int32)
-    # The query's own cell is distance 0 (ref: result[centerChId] = 0).
+    hit = (
+        ((queries.kind[:, None] == AOI_SPHERE) & sphere_hit)
+        | ((queries.kind[:, None] == AOI_BOX) & box_hit)
+        | ((queries.kind[:, None] == AOI_CONE) & cone_hit)
+    ) & cell_valid[None, :]
+    dist = jnp.ceil(center_dist / grid.diagonal).astype(jnp.int32)
     dist = jnp.where(rect_dist <= 0.0, 0, dist)
-    return apply_spots_overlay(hit, dist, queries)
+    if spot_dist_slice is None:
+        return hit, dist
+    is_spots = queries.kind[:, None] == AOI_SPOTS
+    spots_hit = (spot_dist_slice >= 0) & cell_valid[None, :]
+    hit = jnp.where(is_spots, spots_hit, hit)
+    dist = jnp.where(is_spots & spots_hit, spot_dist_slice, dist)
+    return hit, dist
 
 
 def apply_spots_overlay(hit, dist, queries: QuerySet):

@@ -59,6 +59,8 @@ class TPUSpatialController(StaticGrid2DSpatialController):
         self._slot_channel: dict[int, int] = {}
         self._due_pending: dict[int, dict[int, int]] = {}  # ch_id -> {slot: seq}
         self._device_sub_count = 0
+        self._shed_logged: dict[str, float] = {}  # table -> last log time
+        self._overflow_logged = -1e9
 
     def load_config(self, config: dict) -> None:
         super().load_config(config)
@@ -86,6 +88,10 @@ class TPUSpatialController(StaticGrid2DSpatialController):
         if mesh is not None:
             logger.info("spatial engine meshed over %s", mesh)
 
+        # Sharding selection: Config {"Sharding": "cells"} serves from the
+        # space-partitioned plane (all_to_all redistribution + column-block
+        # AOI + ring halos); default "entities" is the psum plane. Only
+        # meaningful with a mesh.
         self.engine = SpatialEngine(
             GridSpec(
                 offset_x=self.world_offset_x,
@@ -98,21 +104,56 @@ class TPUSpatialController(StaticGrid2DSpatialController):
             entity_capacity=global_settings.tpu_entity_capacity,
             query_capacity=global_settings.tpu_query_capacity,
             mesh=mesh,
+            sharding=str(config.get("Sharding", "entities")),
+            cell_bucket=int(config.get("CellBucket", 0)),
         )
+        self.engine.warmup()  # compile before listeners open (see warmup)
 
     # ---- decision plane --------------------------------------------------
+
+    def _shed(self, table: str, detail: str) -> None:
+        """Capacity-overflow policy: degrade visibly, never raise into the
+        channel tick (a full world must keep ticking). Metric always;
+        security log throttled per table (the shed condition repeats
+        every update while the table stays full)."""
+        import time as _time
+
+        from ..core import metrics
+        from ..utils.logger import security_logger
+
+        metrics.tpu_capacity_shed.labels(table=table).inc()
+        now = _time.monotonic()
+        if now - self._shed_logged.get(table, -1e9) >= 5.0:
+            self._shed_logged[table] = now
+            security_logger().warning(
+                "device %s table full: %s (degraded to host path; "
+                "tpu_capacity_shed counts every occurrence)", table, detail
+            )
 
     def notify(self, old_info, new_info, handover_data_provider) -> None:
         """Record the movement; detection happens in the batched tick."""
         entity_id = handover_data_provider(-1, -1)
         if entity_id is None:
             return
-        if entity_id not in self._last_positions:
-            # First sighting: the slot's device prev-cell must reflect the
-            # *old* position or the first crossing is undetectable.
-            slot = self.engine.add_entity(
-                entity_id, new_info.x, new_info.y, new_info.z
-            )
+        if self.engine.slot_of_entity(entity_id) is None:
+            # No device slot — first sighting, OR a previously shed entity
+            # being re-adopted after capacity freed. Either way the slot's
+            # prev-cell must be seeded from the *old* position, or this
+            # very crossing is undetectable (detect_handovers needs
+            # old_cell >= 0).
+            try:
+                slot = self.engine.add_entity(
+                    entity_id, new_info.x, new_info.y, new_info.z
+                )
+            except RuntimeError:
+                # Entity table full: this entity's handovers run the host
+                # orchestration per-notify (the reference's only path,
+                # spatial.go:612-626) until slots free up.
+                self._shed("entity", f"entity {entity_id}")
+                StaticGrid2DSpatialController.notify(
+                    self, old_info, new_info, handover_data_provider
+                )
+                return
             try:
                 old_cell = (
                     self.get_channel_id(old_info)
@@ -121,7 +162,18 @@ class TPUSpatialController(StaticGrid2DSpatialController):
                 self.engine.seed_cell(slot, old_cell)
             except ValueError:
                 pass  # old position outside the world: no baseline
-        self.engine.update_entity(entity_id, new_info.x, new_info.y, new_info.z)
+        try:
+            self.engine.update_entity(
+                entity_id, new_info.x, new_info.y, new_info.z
+            )
+        except RuntimeError:
+            # Tracked host-side but shed from the device table earlier
+            # (track_entity at capacity): host orchestration per-notify.
+            self._shed("entity", f"entity {entity_id}")
+            StaticGrid2DSpatialController.notify(
+                self, old_info, new_info, handover_data_provider
+            )
+            return
         prev = self._last_positions.get(entity_id)
         if prev is None and old_info is not None:
             prev = old_info  # first sighting: the caller's old position
@@ -150,16 +202,28 @@ class TPUSpatialController(StaticGrid2DSpatialController):
         Notifies on an unmoved update, but this controller's tracking and
         follow-interest centering are fed by updates, so a stationary
         entity must still be seen)."""
-        first_sighting = entity_id not in self._last_positions
-        self.engine.update_entity(entity_id, info.x, info.y, info.z)
-        if first_sighting:
-            self._seed_baseline_cell(entity_id, info)
+        # Slot-existence, not host tracking: a shed entity being re-adopted
+        # after capacity freed needs its baseline seeded like a first
+        # sighting (an unseeded prev-cell of -1 hides its next crossing).
+        fresh_slot = self.engine.slot_of_entity(entity_id) is None
+        try:
+            self.engine.update_entity(entity_id, info.x, info.y, info.z)
+        except RuntimeError:
+            self._shed("entity", f"entity {entity_id}")
+        else:
+            if fresh_slot:
+                self._seed_baseline_cell(entity_id, info)
         self._last_positions.setdefault(entity_id, info)
         if handover_data_provider is not None:
             self._providers.setdefault(entity_id, handover_data_provider)
 
     def track_entity(self, entity_id: int, info: SpatialInfo) -> None:
-        self.engine.add_entity(entity_id, info.x, info.y, info.z)
+        try:
+            self.engine.add_entity(entity_id, info.x, info.y, info.z)
+        except RuntimeError:
+            # Stays host-tracked: follow centering and handover still work
+            # (notify degrades per-entity); the world keeps ticking.
+            self._shed("entity", f"entity {entity_id}")
         self._last_positions[entity_id] = info
 
     def untrack_entity(self, entity_id: int) -> None:
@@ -235,7 +299,15 @@ class TPUSpatialController(StaticGrid2DSpatialController):
         no per-move UPDATE_SPATIAL_INTEREST messages needed."""
         info = self._last_positions.get(follow_entity_id)
         center = (info.x, info.z) if info is not None else (0.0, 0.0)
-        self.engine.set_query(conn.id, kind, center, extent, direction, angle)
+        try:
+            self.engine.set_query(conn.id, kind, center, extent, direction,
+                                  angle)
+        except RuntimeError:
+            # Query table full: shed the auto-follow — the client keeps
+            # whatever explicit interest it has (UPDATE_SPATIAL_INTEREST
+            # stays host-served) instead of crashing the handler.
+            self._shed("query", f"conn {conn.id} follow {follow_entity_id}")
+            return
         self._followers[conn.id] = {
             "conn": conn, "entity": follow_entity_id, "kind": kind,
             "extent": extent, "direction": direction, "angle": angle,
@@ -310,6 +382,21 @@ class TPUSpatialController(StaticGrid2DSpatialController):
         handovers = self.engine.handover_list(result)
         metrics.tpu_step_latency.observe(_time.monotonic() - t0)
         metrics.tpu_entities.set(self.engine.entity_count())
+        if "overflow" in result:
+            # Cells-plane bucket overflow: the undelivered entities stay
+            # in the ingest arrays and are re-offered next tick; surface
+            # the shed so a sustained overflow is operator-visible.
+            overflow = self.engine.last_overflow
+            metrics.tpu_cell_overflow.set(overflow)
+            if overflow and _time.monotonic() - self._overflow_logged >= 5.0:
+                self._overflow_logged = _time.monotonic()
+                from ..utils.logger import security_logger
+
+                security_logger().warning(
+                    "cells-plane bucket overflow: %d entities undelivered "
+                    "this tick (slots %s...), re-offered next tick",
+                    overflow, self.engine.undelivered_slots(result)[:8],
+                )
         self._publish_due(result)
         for entity_id, src_cell, dst_cell in handovers:
             self._run_handover(entity_id, src_cell, dst_cell)

@@ -31,7 +31,7 @@ def runtime():
     yield gch
 
 
-def make_tpu_world():
+def make_tpu_world(**extra_cfg):
     from channeld_tpu.core.settings import global_settings
 
     global_settings.tpu_entity_capacity = 64
@@ -40,7 +40,7 @@ def make_tpu_world():
     ctl.load_config(
         dict(WorldOffsetX=0, WorldOffsetZ=0, GridWidth=100, GridHeight=100,
              GridCols=2, GridRows=1, ServerCols=2, ServerRows=1,
-             ServerInterestBorderSize=1)
+             ServerInterestBorderSize=1, **extra_cfg)
     )
     set_spatial_controller(ctl)
     server = StubConnection(1, ConnectionType.SERVER)
@@ -60,7 +60,20 @@ def data_updates(conn):
 
 
 def test_spatial_fanout_consumes_device_due_mask():
-    ctl, server = make_tpu_world()
+    _run_fanout_consumes_device_due_mask()
+
+
+def test_spatial_fanout_device_due_cells_sharded():
+    """The same device-due contract served from the cell-sharded plane
+    over the 8-virtual-device mesh (Config {"Sharding": "cells"})."""
+    ctl, _ = _run_fanout_consumes_device_due_mask(
+        MeshDevices=8, Sharding="cells")
+    assert ctl.engine._sharding == "cells"
+    assert ctl.engine._mesh is not None
+
+
+def _run_fanout_consumes_device_due_mask(**extra_cfg):
+    ctl, server = make_tpu_world(**extra_cfg)
     ch = get_channel(START)
     ch.init_data(sim_pb2.SimSpatialChannelData(), None)
 
@@ -114,6 +127,7 @@ def test_spatial_fanout_consumes_device_due_mask():
     unsubscribe_from_channel(client, ch)
     assert foc.device_sub_slot is None
     assert slot not in ch.device_sub_slots
+    return ctl, server
 
 
 def test_spatial_fanout_host_fallback_without_engine_tick():

@@ -228,6 +228,14 @@ def test_tpu_controller_handover_parity_meshed():
     _run_tpu_handover_parity({"MeshDevices": 8})
 
 
+def test_tpu_controller_handover_parity_cells():
+    """Config {"Sharding": "cells"} serves the same orchestration from the
+    space-partitioned plane (all_to_all redistribution + column-block AOI,
+    parallel/spatial_alltoall.py) — the serving-backend form of the
+    reference's per-server authority blocks (spatial.go:481-590)."""
+    _run_tpu_handover_parity({"MeshDevices": 8, "Sharding": "cells"})
+
+
 def _run_tpu_handover_parity(extra_cfg):
     from channeld_tpu.spatial.tpu_controller import TPUSpatialController
     from channeld_tpu.core.settings import global_settings
@@ -243,6 +251,8 @@ def _run_tpu_handover_parity(extra_cfg):
     )
     if extra_cfg.get("MeshDevices"):
         assert ctl.engine._mesh is not None
+    if extra_cfg.get("Sharding"):
+        assert ctl.engine._sharding == extra_cfg["Sharding"]
     set_spatial_controller(ctl)
     server_a = StubConnection(1, ConnectionType.SERVER)
     server_b = StubConnection(2, ConnectionType.SERVER)

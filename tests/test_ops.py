@@ -446,11 +446,14 @@ def test_engine_mesh_matches_single_device():
     slice without behavior drift (VERDICT r1 #2)."""
     from channeld_tpu.parallel.mesh import make_mesh, make_mesh_2d
 
-    for mesh in (make_mesh(), make_mesh_2d(2)):
+    for mesh, sharding in ((make_mesh(), "entities"),
+                           (make_mesh_2d(2), "entities"),
+                           (make_mesh(), "cells")):
         single = SpatialEngine(GRID, entity_capacity=256, query_capacity=128,
                                sub_capacity=64, max_handovers=64)
         meshed = SpatialEngine(GRID, entity_capacity=256, query_capacity=128,
-                               sub_capacity=64, max_handovers=64, mesh=mesh)
+                               sub_capacity=64, max_handovers=64, mesh=mesh,
+                               sharding=sharding)
         res_s = _drive_engine(single, np.random.default_rng(42))
         res_m = _drive_engine(meshed, np.random.default_rng(42))
         for out_s, out_m in zip(res_s, res_m):
@@ -484,9 +487,11 @@ def test_engine_handover_overflow_never_loses_crossings():
     be consumed (a clamped row would be committed on device and lost)."""
     from channeld_tpu.parallel.mesh import make_mesh
 
-    for mesh in (None, make_mesh()):
+    for mesh, sharding in ((None, "entities"), (make_mesh(), "entities"),
+                           (make_mesh(), "cells")):
         eng = SpatialEngine(GRID, entity_capacity=64, query_capacity=8,
-                            sub_capacity=8, max_handovers=10, mesh=mesh)
+                            sub_capacity=8, max_handovers=10, mesh=mesh,
+                            sharding=sharding)
         for eid in range(40):
             eng.add_entity(2000 + eid, -100.0, 0.0, -100.0)  # cell 0
         eng.tick(now_ms=10)

@@ -98,3 +98,183 @@ def test_cell_sharded_overflow_reported_not_dropped():
     delivered_ids = set(np.asarray(owned_ids)[np.asarray(owned_ids) >= 0])
     assert delivered_ids.isdisjoint(set(ids[und]))
     assert delivered_ids | set(ids[und]) == set(ids)
+
+
+# ---- the serving step (engine backend, Config {"Sharding": "cells"}) ----
+
+
+def _serving_world(n=64, q=8, s=32, seed=7):
+    import jax.numpy as jnp
+    from channeld_tpu.ops.spatial_ops import QuerySet
+
+    rng = np.random.default_rng(seed)
+    pos = jnp.asarray(rng.uniform(-50, 650, (n, 3)).astype(np.float32))
+    prev = jnp.asarray(rng.integers(-1, 24, n), jnp.int32)
+    valid = jnp.asarray(rng.random(n) < 0.9)
+    queries = QuerySet(
+        jnp.asarray(rng.integers(0, 4, q), jnp.int32),
+        jnp.asarray(rng.uniform(0, 600, (q, 2)).astype(np.float32)),
+        jnp.asarray(rng.uniform(50, 250, (q, 2)).astype(np.float32)),
+        jnp.tile(jnp.asarray([[1.0, 0.0]], jnp.float32), (q, 1)),
+        jnp.full(q, 0.6, jnp.float32),
+    )
+    subs = (
+        jnp.asarray(rng.integers(0, 100, s), jnp.int32),
+        jnp.asarray(rng.choice([20, 50, 100], s), jnp.int32),
+        jnp.asarray(rng.random(s) < 0.9),
+    )
+    return pos, prev, valid, queries, subs
+
+
+def test_cell_serving_step_matches_dense():
+    """The full serving contract (cell_of, committed baseline, handovers,
+    occupancy, [Q,C] interest/dist, due) from the space-partitioned plane
+    equals the dense single-device spatial_step — on a 6x4 grid whose 24
+    cells do NOT divide into row blocks over 8 shards (padded cell
+    ranges)."""
+    from channeld_tpu.ops.spatial_ops import spatial_step
+    from channeld_tpu.parallel.mesh import merge_handover_shards
+    from channeld_tpu.parallel.spatial_alltoall import (
+        build_cell_serving_step,
+        cell_serving_spatial_step,
+    )
+
+    grid = GridSpec(offset_x=0.0, offset_z=0.0, cell_w=100.0, cell_h=100.0,
+                    cols=6, rows=4)
+    mesh = make_space_mesh()
+    pos, prev, valid, queries, subs = _serving_world()
+    dense = spatial_step(grid, pos, prev.copy(), valid, queries, subs, 64,
+                         jnp.int32(120))
+    step = build_cell_serving_step(grid, mesh, bucket=8,
+                                   max_handovers_per_shard=8)
+    out = cell_serving_spatial_step(step, pos, prev.copy(), valid, queries,
+                                    subs, 120)
+    np.testing.assert_array_equal(np.asarray(out["cell_of"]),
+                                  np.asarray(dense["cell_of"]))
+    np.testing.assert_array_equal(np.asarray(out["committed_prev"]),
+                                  np.asarray(dense["committed_prev"]))
+    np.testing.assert_array_equal(np.asarray(out["cell_counts"]),
+                                  np.asarray(dense["cell_counts"]))
+    np.testing.assert_array_equal(np.asarray(out["interest"]),
+                                  np.asarray(dense["interest"]))
+    interest = np.asarray(dense["interest"])
+    np.testing.assert_array_equal(np.asarray(out["dist"])[interest],
+                                  np.asarray(dense["dist"])[interest])
+    np.testing.assert_array_equal(np.asarray(out["due"]),
+                                  np.asarray(dense["due"]))
+    count, rows = merge_handover_shards(out["handover_counts"],
+                                        out["handovers"])
+    dense_rows = np.asarray(dense["handovers"])[: int(dense["handover_count"])]
+    assert count == int(dense["handover_count"])
+    assert {tuple(r) for r in rows.tolist()} == \
+        {tuple(r) for r in dense_rows.tolist()}
+    assert not np.asarray(out["undelivered"]).any()
+    assert int(np.asarray(out["overflow"]).sum()) == 0
+
+
+def test_cell_serving_step_spots_overlay():
+    """Spots queries ride the sliced [Q, block] table through the
+    column-block AOI and match the dense overlay."""
+    from channeld_tpu.ops.spatial_ops import AOI_SPOTS, spatial_step
+    from channeld_tpu.parallel.spatial_alltoall import (
+        build_cell_serving_step,
+        cell_serving_spatial_step,
+    )
+
+    grid = GridSpec(offset_x=0.0, offset_z=0.0, cell_w=100.0, cell_h=100.0,
+                    cols=6, rows=4)
+    mesh = make_space_mesh()
+    pos, prev, valid, queries, subs = _serving_world()
+    spot = np.full((queries.kind.shape[0], grid.num_cells), -1, np.int32)
+    spot[0, [2, 11, 17]] = [0, 3, 1]
+    queries = queries._replace(
+        kind=queries.kind.at[0].set(AOI_SPOTS),
+        spot_dist=jnp.asarray(spot),
+    )
+    dense = spatial_step(grid, pos, prev.copy(), valid, queries, subs, 64,
+                         jnp.int32(120))
+    step = build_cell_serving_step(grid, mesh, bucket=8,
+                                   max_handovers_per_shard=8,
+                                   with_spots=True)
+    out = cell_serving_spatial_step(step, pos, prev.copy(), valid, queries,
+                                    subs, 120)
+    np.testing.assert_array_equal(np.asarray(out["interest"]),
+                                  np.asarray(dense["interest"]))
+    interest = np.asarray(dense["interest"])
+    np.testing.assert_array_equal(np.asarray(out["dist"])[interest],
+                                  np.asarray(dense["dist"])[interest])
+
+
+def test_cell_serving_spots_partial_last_block():
+    """Regression: on a grid whose cell count does NOT divide into blocks
+    (5x5 = 25 cells over 8 shards, cells_blk 4, shard 6 owns 24), the
+    spots table slice for the last partial block must not clamp — a
+    clamped dynamic_slice start misaligned spot columns and silently
+    dropped interest in the final cells."""
+    from channeld_tpu.ops.spatial_ops import AOI_SPOTS, spatial_step
+    from channeld_tpu.parallel.spatial_alltoall import (
+        build_cell_serving_step,
+        cell_serving_spatial_step,
+    )
+
+    grid = GridSpec(offset_x=0.0, offset_z=0.0, cell_w=100.0, cell_h=100.0,
+                    cols=5, rows=5)
+    mesh = make_space_mesh()
+    pos, prev, valid, queries, subs = _serving_world()
+    spot = np.full((queries.kind.shape[0], grid.num_cells), -1, np.int32)
+    spot[0, [3, 11, 24]] = [0, 3, 1]  # 24 = the last cell, partial block
+    queries = queries._replace(
+        kind=queries.kind.at[0].set(AOI_SPOTS),
+        spot_dist=jnp.asarray(spot),
+    )
+    dense = spatial_step(grid, pos, prev.copy(), valid, queries, subs, 64,
+                         jnp.int32(120))
+    step = build_cell_serving_step(grid, mesh, bucket=8,
+                                   max_handovers_per_shard=8,
+                                   with_spots=True)
+    out = cell_serving_spatial_step(step, pos, prev.copy(), valid, queries,
+                                    subs, 120)
+    np.testing.assert_array_equal(np.asarray(out["interest"]),
+                                  np.asarray(dense["interest"]))
+    assert np.asarray(out["interest"])[0, 24], "border-cell spot lost"
+    interest = np.asarray(dense["interest"])
+    np.testing.assert_array_equal(np.asarray(out["dist"])[interest],
+                                  np.asarray(dense["dist"])[interest])
+
+
+def test_cell_serving_overflow_reoffers_next_tick():
+    """Bucket overflow marks undelivered (occupancy short by exactly that
+    many); the entities stay in the ingest arrays, so the next tick —
+    with the hotspot dispersed — delivers them. Nothing is ever lost."""
+    from channeld_tpu.parallel.spatial_alltoall import (
+        build_cell_serving_step,
+        cell_serving_spatial_step,
+    )
+
+    grid = GridSpec(offset_x=0.0, offset_z=0.0, cell_w=100.0, cell_h=100.0,
+                    cols=4, rows=8)
+    mesh = make_space_mesh()
+    n = 64
+    pos = np.zeros((n, 3), np.float32)
+    pos[:, 0] = 50.0
+    pos[:, 2] = 50.0  # hotspot: everyone in cell 0 -> shard 0
+    prev = jnp.full(n, -1, jnp.int32)
+    valid = jnp.ones(n, bool)
+    _, _, _, queries, subs = _serving_world()
+    step = build_cell_serving_step(grid, mesh, bucket=2,
+                                   max_handovers_per_shard=16)
+    out = cell_serving_spatial_step(step, jnp.asarray(pos), prev, valid,
+                                    queries, subs, 120)
+    und = np.asarray(out["undelivered"])
+    delivered = 2 * mesh.devices.size  # bucket x source shards
+    assert int(und.sum()) == n - delivered
+    assert int(np.asarray(out["cell_counts"])[0]) == delivered
+    # Disperse the hotspot so each source shard sends exactly one entity
+    # to each owner (bucket 2 suffices); every formerly-undelivered
+    # entity delivers.
+    pos[:, 2] = (np.arange(n) % mesh.devices.size) * 100.0 + 50.0
+    out2 = cell_serving_spatial_step(step, jnp.asarray(pos),
+                                     out["committed_prev"], valid, queries,
+                                     subs, 153)
+    assert int(np.asarray(out2["undelivered"]).sum()) == 0
+    assert int(np.asarray(out2["cell_counts"]).sum()) == n
