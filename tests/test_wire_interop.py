@@ -199,6 +199,99 @@ def test_tps_session_control_plane_dispatch():
     assert any(t >= 100 for t in msg_types)  # user-space traffic present
 
 
+def test_tps_session_with_unrealpb_family_spawn_resolves():
+    """The tps session with the unrealpb compat family registered: the
+    recorded UE stream (AUTH, SUB, LOW_LEVEL=100 bunches) replays clean,
+    and a SPAWN (103) injected on the same wire — the message a UE
+    spatial server sends on actor spawn, absent from this client-side
+    recording because SPAWN is server-originated — decodes via
+    compat/unrealpb.proto and lands its SpatialEntityState in the spatial
+    channel's data (ref: pkg/unreal/message.go:20-128, the payload-
+    resolving path the recorded LOW_LEVEL bunches can't exercise: they
+    are raw UE NetConnection bits, not protobuf)."""
+    from channeld_tpu.compat import unrealpb_pb2 as unrealpb
+    from channeld_tpu.compat.unreal import MSG_SPAWN, register_unreal_types
+    from channeld_tpu.core.channel import get_channel
+    from channeld_tpu.core.message import MessageContext
+    from channeld_tpu.core.subscription import subscribe_to_channel
+    from channeld_tpu.core.types import MessageType as MT
+    from channeld_tpu.protocol import wire_pb2
+    from channeld_tpu.spatial.controller import set_spatial_controller
+    from channeld_tpu.spatial.grid import StaticGrid2DSpatialController
+
+    register_unreal_types()
+    ctl = StaticGrid2DSpatialController()
+    ctl.load_config(dict(WorldOffsetX=0, WorldOffsetZ=0, GridWidth=100,
+                         GridHeight=100, GridCols=2, GridRows=1,
+                         ServerCols=1, ServerRows=1,
+                         ServerInterestBorderSize=1))
+    set_spatial_controller(ctl)
+    gch = get_global_channel()
+
+    # A UE spatial server connection owning the world's channels; auths
+    # over the wire like any reference server (FSM INIT -> OPEN).
+    server_transport = FakeTransport()
+    server = add_connection(server_transport, ConnectionType.SERVER)
+    auth_pkt = wire_pb2.Packet()
+    amp = auth_pkt.messages.add()
+    amp.channelId = 0
+    amp.msgType = MT.AUTH
+    amp.msgBody = control_pb2.AuthMessage(
+        playerIdentifierToken="tps-server", loginToken="lt"
+    ).SerializeToString()
+    server.on_bytes(encode_packet(auth_pkt))
+    from channeld_tpu.core.types import ConnectionState
+
+    for _ in range(50):
+        gch.tick_once(gch.get_time())
+        if server.state == ConnectionState.AUTHENTICATED:
+            break
+        time.sleep(0.01)
+    assert server.state == ConnectionState.AUTHENTICATED
+    ctx = MessageContext(
+        msg_type=MT.CREATE_CHANNEL,
+        msg=control_pb2.CreateChannelMessage(),
+        connection=server,
+    )
+    channels = ctl.create_channels(ctx)
+    for ch in channels:
+        subscribe_to_channel(server, ch, None)
+        ch.init_data(unrealpb.SpatialChannelData(), None)
+
+    # Replay the recorded UE client stream through the gateway.
+    transport = FakeTransport()
+    conn = add_connection(transport, ConnectionType.CLIENT)
+    for rp in load_session(TPS_CPR).packets:
+        conn.on_bytes(encode_packet(rp.packet))
+        gch.tick_once(gch.get_time())
+    assert not conn.is_closing()
+
+    # The server spawns an actor at UE (x=150, y=50) — gateway cell 1 —
+    # addressed to cell 0's channel; the handler re-routes and inserts.
+    net_guid = 0x80000 + 77
+    spawn = unrealpb.SpawnObjectMessage(channelId=0x10000)
+    spawn.obj.netGUID = net_guid
+    spawn.obj.classPath = "/Game/Blueprints/BP_TestActor"
+    spawn.location.x = 150.0
+    spawn.location.y = 50.0   # UE ground axis -> gateway z
+    spawn.location.z = 88.0   # UE height; the 2D grid ignores it
+    fwd = wire_pb2.ServerForwardMessage(payload=spawn.SerializeToString())
+    pkt = wire_pb2.Packet()
+    mp = pkt.messages.add()
+    mp.channelId = 0x10000
+    mp.msgType = MSG_SPAWN
+    mp.msgBody = fwd.SerializeToString()
+    server.on_bytes(encode_packet(pkt))
+    get_channel(0x10000).tick_once(0)
+    get_channel(0x10001).tick_once(0)
+
+    data = get_channel(0x10001).get_data_message()
+    assert net_guid in data.entities, "spawn did not land in spatial data"
+    assert data.entities[net_guid].objRef.classPath == \
+        "/Game/Blueprints/BP_TestActor"
+    assert net_guid not in get_channel(0x10000).get_data_message().entities
+
+
 def test_cross_family_chat_merge_converts_without_data_loss():
     """A chatpb update merging into chtpu-native chat data (or vice
     versa) converts via serialize/parse before mutating — a mid-merge
