@@ -48,7 +48,9 @@ class Client:
         self.created_channels: set[int] = set()
         self.listed_channels: set[int] = set()
         self.connected = False
-        self._decoder = FrameDecoder()
+        # Client-side decode accepts >64KB server packets via the 3-byte
+        # size escape (ref: client.go:191-196; the server cap stays 64KB).
+        self._decoder = FrameDecoder(extended_size=True)
         self._incoming: list = []  # (msg, channel_id, stub_id, handlers)
         self._outgoing: list[wire_pb2.MessagePack] = []
         self._lock = threading.Lock()

@@ -33,13 +33,13 @@ packet_sent = Counter("packets_out", "Packets sent", ["conn_type"], registry=reg
 bytes_received = Counter("bytes_in", "Bytes received", ["conn_type"], registry=registry)
 bytes_sent = Counter("bytes_out", "Bytes sent", ["conn_type"], registry=registry)
 packet_dropped = Counter(
-    "packets_dropped", "Dropped packets", ["conn_type"], registry=registry
+    "packets_drop", "Dropped packets", ["conn_type"], registry=registry
 )
 packet_fragmented = Counter(
-    "packets_fragmented", "Partially-read packets", ["conn_type"], registry=registry
+    "packets_frag", "Partially-read packets", ["conn_type"], registry=registry
 )
 packet_combined = Counter(
-    "packets_combined", "Messages combined into one packet", ["conn_type"],
+    "packets_comb", "Messages combined into one packet", ["conn_type"],
     registry=registry,
 )
 connection_num = Gauge(
@@ -50,7 +50,7 @@ connection_closed = Counter(
     "connection_closed", "Connections closed", ["conn_type"], registry=registry
 )
 channel_tick_duration = Histogram(
-    "channel_tick_duration_seconds",
+    "channel_tick_duration",
     "Channel tick duration",
     ["channel_type"],
     buckets=(0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5),
@@ -85,6 +85,39 @@ tpu_capacity_shed = Counter(
     ["table"],
     registry=registry,
 )
+handover_count = Counter(
+    "handovers",
+    "Cross-cell entity handovers orchestrated",
+    registry=registry,
+)
+# The goroutine-count analog: live asyncio tasks (one per channel tick,
+# listener, pump). Updated by the server's heartbeat (serve loops) and by
+# any caller of sample_runtime().
+asyncio_tasks = Gauge(
+    "asyncio_tasks", "Live asyncio tasks", registry=registry
+)
+
+# Python process + GC runtime families — the analog of the reference
+# dashboard's go_memstats/go_gc/goroutines panels (grafana/dashboard.json).
+try:  # pragma: no cover - collector support is environment-dependent
+    from prometheus_client.gc_collector import GCCollector
+    from prometheus_client.process_collector import ProcessCollector
+
+    ProcessCollector(registry=registry)
+    GCCollector(registry=registry)
+except Exception:
+    pass
+
+
+def sample_runtime() -> None:
+    """Refresh point-in-time runtime gauges (asyncio task count)."""
+    import asyncio
+
+    try:
+        loop = asyncio.get_running_loop()
+    except RuntimeError:
+        return
+    asyncio_tasks.set(len(asyncio.all_tasks(loop)))
 
 
 def serve_metrics(port: int = 8080) -> None:

@@ -2,11 +2,13 @@
 
 ``-profile cpu`` -> cProfile, ``-profile mem`` -> tracemalloc,
 ``-profile tpu`` -> a jax profiler trace (XLA ops, device timelines,
-HLO — viewable in TensorBoard or Perfetto). Results are written to the
+HLO — viewable in TensorBoard or Perfetto), ``-profile tasks`` -> the
+asyncio analog of the reference's "goroutine" mode: a dump of every
+live task (the per-channel tick tasks, listeners, pumps) with its
+current stack, plus every OS thread's stack. Results are written to the
 profile path on shutdown, with a signal-safe stop on SIGINT/SIGTERM
-like the reference's pkg/profile integration. The reference's
-"goroutine" mode has no analog here; the runtime is a single asyncio
-loop plus the device stream the tpu trace covers.
+like the reference's pkg/profile integration; ``dump_tasks()`` can also
+be called at any point for a live snapshot.
 """
 
 from __future__ import annotations
@@ -24,12 +26,50 @@ logger = get_logger("profiling")
 _cpu_profiler = None
 _mem_tracing = False
 _tpu_trace_dir: Optional[str] = None
+_tasks_mode = False
 _profile_path = "profiles"
 
 
+def dump_tasks(out=None) -> str:
+    """Write every asyncio task's current stack + every thread's stack —
+    the honest analog of the reference's `-profile=goroutine` dump
+    (profiling.go:12-31): the runtime's unit of concurrency is the task
+    (one per channel tick, listener, pump), so this is what "where is
+    everything stuck" means here. Returns the formatted dump."""
+    import asyncio
+    import io
+    import sys
+    import traceback
+
+    buf = io.StringIO()
+    try:
+        loop = asyncio.get_running_loop()
+    except RuntimeError:
+        loop = None
+    tasks = asyncio.all_tasks(loop) if loop is not None else set()
+    buf.write(f"=== asyncio tasks: {len(tasks)} ===\n")
+    for task in sorted(tasks, key=lambda t: t.get_name()):
+        coro = task.get_coro()
+        state = "cancelled" if task.cancelled() else (
+            "done" if task.done() else "running")
+        buf.write(f"\n--- task {task.get_name()} [{state}] "
+                  f"{getattr(coro, '__qualname__', coro)!r}\n")
+        for line in task.get_stack(limit=12):
+            buf.write("".join(traceback.format_stack(line, limit=1)))
+    buf.write(f"\n=== threads: {len(sys._current_frames())} ===\n")
+    for tid, frame in sys._current_frames().items():
+        buf.write(f"\n--- thread {tid}\n")
+        buf.write("".join(traceback.format_stack(frame, limit=12)))
+    text = buf.getvalue()
+    if out is not None:
+        out.write(text)
+    return text
+
+
 def start_profiling(kind: str, profile_path: str = "profiles") -> None:
-    """(ref: StartProfiling). kind in {"", "cpu", "mem", "tpu"}."""
-    global _cpu_profiler, _mem_tracing, _tpu_trace_dir, _profile_path
+    """(ref: StartProfiling). kind in {"", "cpu", "mem", "tpu", "tasks"}."""
+    global _cpu_profiler, _mem_tracing, _tpu_trace_dir, _tasks_mode, \
+        _profile_path
     if not kind:
         return
     _profile_path = profile_path
@@ -52,6 +92,9 @@ def start_profiling(kind: str, profile_path: str = "profiles") -> None:
         _tpu_trace_dir = os.path.join(profile_path, "tpu_trace")
         jax.profiler.start_trace(_tpu_trace_dir)
         logger.info("device trace started -> %s", _tpu_trace_dir)
+    elif kind == "tasks":
+        _tasks_mode = True
+        logger.info("task-dump profiling armed (dump written on stop)")
     else:
         raise ValueError(f"invalid profile type: {kind}")
 
@@ -64,8 +107,15 @@ def start_profiling(kind: str, profile_path: str = "profiles") -> None:
 
 
 def stop_profiling() -> Optional[str]:
-    global _cpu_profiler, _mem_tracing, _tpu_trace_dir
+    global _cpu_profiler, _mem_tracing, _tpu_trace_dir, _tasks_mode
     stamp = time.strftime("%Y%m%d%H%M%S")
+    if _tasks_mode:
+        _tasks_mode = False
+        path = os.path.join(_profile_path, f"tasks_{stamp}.txt")
+        with open(path, "w") as f:
+            dump_tasks(f)
+        logger.info("task dump written to %s", path)
+        return path
     if _tpu_trace_dir is not None:
         import jax
 

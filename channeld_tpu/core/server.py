@@ -15,6 +15,7 @@ connection; a shared pump is the asyncio-idiomatic equivalent).
 from __future__ import annotations
 
 import asyncio
+import time
 from collections import deque
 from typing import Optional
 
@@ -309,10 +310,17 @@ async def flush_loop(interval: float = 0.001) -> None:
     connection.go:180-184). The 1ms cadence is the packet-coalescing
     window; each cycle only visits connections that queued output since
     the last one, so idle connections cost nothing."""
+    from . import metrics
+
+    last_sample = 0.0
     while True:
         for conn in drain_pending_flush():
             if not conn.is_closing() and conn.send_queue:
                 conn.flush()
+        now = time.monotonic()
+        if now - last_sample >= 5.0:  # asyncio_tasks gauge (goroutines analog)
+            last_sample = now
+            metrics.sample_runtime()
         await asyncio.sleep(interval)
 
 

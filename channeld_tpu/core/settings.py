@@ -157,7 +157,8 @@ class GlobalSettings:
                        help="-1 Debug, 0 Info, 1 Warn, 2 Error")
         p.add_argument("-logfile", type=str, default=None)
         p.add_argument("-profile", type=str, default="",
-                       help="cpu | mem | tpu (process profile or device trace)")
+                       help="cpu | mem | tpu | tasks (process profile, "
+                            "device trace, or asyncio task dump)")
         p.add_argument("-profilepath", type=str, default=self.profile_path)
         p.add_argument("-sn", type=str, default=self.server_network,
                        help="server network type: tcp | ws")

@@ -83,11 +83,25 @@ class FrameDecoder:
     fragmented_count: int = 0
     # Last compression type seen from the peer; the send path mirrors it.
     peer_compression: int = 0
+    # Client-side mode: accept the reference client's 3-byte size escape
+    # (tag byte 1 != 'H' carries the size's high byte, client.go:191-196)
+    # so server->client packets over 64KB decode. The gateway's own
+    # decoder stays strict — the reference server never WRITES >64KB and
+    # treats an escaped tag as hostile. Python path only (the native
+    # codec implements the strict gateway wire).
+    extended_size: bool = False
 
     def feed(self, data: bytes) -> list[bytes]:
         # Eager, not a generator: data must land in the buffer even when
         # the caller discards the return value (no frames yet).
         self._buf.extend(data)
+        if self.extended_size:
+            out: list[bytes] = []
+            while True:
+                body = self._next_frame()
+                if body is None:
+                    return out
+                out.append(body)
         if _native is not None:
             try:
                 # bytearray passes the buffer protocol: no copy.
@@ -116,9 +130,30 @@ class FrameDecoder:
             if buf:
                 self.fragmented_count += 1
             return None
-        if buf[0] != _MAGIC0 or buf[1] != _MAGIC1:
+        if buf[0] != _MAGIC0:
             raise FramingError(f"invalid tag: {bytes(buf[:4])!r}")
-        size = (buf[2] << 8) | buf[3]
+        if self.extended_size and buf[1] != _MAGIC1:
+            # 3-byte size escape (client.go:191-196): byte 1 carries the
+            # topmost size byte, allowing server->client packets past
+            # 64KB. Two wire-inherited quirks: (a) the reference client
+            # treats a literal 'N' in byte 2 as zero — a misparse for
+            # honest ~20KB frames whose size high byte IS 0x4E;
+            # deliberately not inherited. (b) a topmost byte of 'H'
+            # (0x48) is indistinguishable from the strict 2-byte form,
+            # so escaped sizes 0x480000-0x48FFFF (~4.7MB) are
+            # unrepresentable in this tag encoding — writers must pad
+            # past the hole; sizes at/above it are rejected here rather
+            # than silently desyncing the stream.
+            size = (buf[1] << 16) | (buf[2] << 8) | buf[3]
+            if size >= 0x480000:
+                raise FramingError(
+                    f"extended frame size {size} in/past the 0x48 tag "
+                    "collision hole"
+                )
+        else:
+            if buf[1] != _MAGIC1:
+                raise FramingError(f"invalid tag: {bytes(buf[:4])!r}")
+            size = (buf[2] << 8) | buf[3]
         if size == 0:
             raise FramingError("zero-size frame")
         full = HEADER_SIZE + size
@@ -130,7 +165,19 @@ class FrameDecoder:
         del buf[:full]
         if ct == 1:
             self.peer_compression = 1
-            body = snappy.uncompress(body)
+            try:
+                if self.extended_size:
+                    # The strict cap (a small multiple of 64KB) is the
+                    # gateway's decompression-bomb guard; extended mode
+                    # exists to accept large server packets, so the cap
+                    # scales with the extended size ceiling instead.
+                    body = snappy.uncompress(body, max_len=0x480000 * 4)
+                else:
+                    body = snappy.uncompress(body)
+            except ValueError as e:
+                # Corrupt or bomb-sized snappy data is a stream-fatal
+                # framing condition, not a caller error.
+                raise FramingError(str(e)) from None
         elif ct != 0:
             # Unknown compression tags are ignored (treated as raw),
             # mirroring the reference's CompressionType_name check.

@@ -436,6 +436,9 @@ class StaticGrid2DSpatialController:
         handover_entities = entity_channel.get_handover_entities(handover_entity_id)
         if not handover_entities:
             return  # a member is locked, or nothing to move
+        from ..core import metrics
+
+        metrics.handover_count.inc()
 
         # Step 1: cross-server — swap entity-channel ownership first so the
         # src server's residual updates are ignored (prevents handover loops).

@@ -379,7 +379,7 @@ def fetch_metrics(port: int = 8080) -> dict:
     for line in text.splitlines():
         if line.startswith("#"):
             continue
-        for key in ("messages_in_total", "messages_out_total", "packets_dropped_total",
+        for key in ("messages_in_total", "messages_out_total", "packets_drop_total",
                     "connection_num", "fanout_decision_latency_seconds_sum",
                     "fanout_decision_latency_seconds_count"):
             if line.startswith(key):

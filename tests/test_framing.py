@@ -84,3 +84,87 @@ def test_snappy_roundtrip_raw():
     c = snappy.compress(data)
     assert len(c) < len(data)
     assert snappy.uncompress(c) == data
+
+
+def test_extended_size_decoder_accepts_over_64kb():
+    """Client-side mode (ref: client.go:191-196): a 3-byte size escape in
+    tag byte 1 carries server->client packets past the 64KB cap; the
+    strict gateway decoder must keep rejecting the same frame."""
+    from channeld_tpu.protocol.framing import (
+        FrameDecoder,
+        FramingError,
+        _MAGIC0,
+    )
+
+    body = bytes((i * 31) & 0xFF for i in range(150_000))  # > 0xFFFF
+    size = len(body)
+    frame = bytes((
+        _MAGIC0, (size >> 16) & 0xFF, (size >> 8) & 0xFF, size & 0xFF, 0
+    )) + body
+
+    ext = FrameDecoder(extended_size=True)
+    out = []
+    for i in range(0, len(frame), 8192):  # fragmented delivery
+        out.extend(ext.feed(frame[i:i + 8192]))
+    assert out == [body]
+
+    import pytest as _pytest
+
+    strict = FrameDecoder()
+    with _pytest.raises(FramingError):
+        strict.feed(frame)
+
+
+def test_extended_size_decoder_still_reads_normal_frames():
+    """Extended mode parses ordinary 'CH'-tagged frames identically —
+    including sizes whose high byte happens to be 0x4E ('N'), which the
+    reference client misparses (quirk deliberately not inherited)."""
+    from channeld_tpu.protocol import encode_frame
+    from channeld_tpu.protocol.framing import FrameDecoder
+
+    tricky = bytes(19970)  # size 0x4E02: high byte is literally 'N'
+    small = b"hello-world"
+    ext = FrameDecoder(extended_size=True)
+    frames = ext.feed(encode_frame(tricky, 0) + encode_frame(small, 0))
+    assert frames == [tricky, small]
+
+
+def test_extended_size_decompresses_large_snappy_bodies():
+    """The >64KB client path must also lift the decompression-bomb cap:
+    a compressed server packet inflating past 262KB is exactly what
+    extended mode exists for."""
+    from channeld_tpu.protocol import snappy
+    from channeld_tpu.protocol.framing import FrameDecoder, _MAGIC0
+
+    body = bytes(500_000)  # inflates well past the strict 4*64KB cap
+    compressed = snappy.compress(body)
+    size = len(compressed)
+    assert size <= 0xFFFFFF
+    frame = bytes((
+        _MAGIC0, (size >> 16) & 0xFF, (size >> 8) & 0xFF, size & 0xFF, 1
+    )) + compressed
+    ext = FrameDecoder(extended_size=True)
+    assert ext.feed(frame) == [body]
+
+
+def test_extended_size_rejects_tag_collision_hole():
+    """Escaped sizes whose top byte is 'H' (0x48) are unrepresentable in
+    the reference's tag encoding; reject instead of desyncing."""
+    import pytest as _pytest
+
+    from channeld_tpu.protocol.framing import (
+        FrameDecoder,
+        FramingError,
+        _MAGIC0,
+    )
+
+    frame = bytes((_MAGIC0, 0x48, 0x00, 0x01, 0)) + b"x"
+    # In strict terms this parses as a 1-byte frame — the ambiguity —
+    # so extended mode must also read it as the strict form...
+    ext = FrameDecoder(extended_size=True)
+    assert ext.feed(frame) == [b"x"]
+    # ...and an actually-escaped size in the hole is rejected.
+    frame2 = bytes((_MAGIC0, 0x49, 0x00, 0x00, 0))
+    ext2 = FrameDecoder(extended_size=True)
+    with _pytest.raises(FramingError):
+        ext2.feed(frame2 + bytes(16))

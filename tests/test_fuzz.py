@@ -23,6 +23,18 @@ OPEN_FSM = {
 }
 
 
+def make_rng(default_seed: int) -> random.Random:
+    """Deterministic by default; FUZZ_RANDOM=1 picks a fresh seed per
+    test. Either way the seed is printed, so pytest's captured-output
+    report names the exact failing case — a randomized decoder/dispatch
+    failure without its seed is lost evidence."""
+    seed = default_seed
+    if os.environ.get("FUZZ_RANDOM") == "1":
+        seed = random.SystemRandom().randrange(2**32)
+    print(f"[fuzz] seed={seed}")
+    return random.Random(seed)
+
+
 @pytest.fixture(autouse=True)
 def runtime():
     fresh_runtime()
@@ -34,7 +46,7 @@ def runtime():
 
 
 def test_decoder_random_bytes_never_crash():
-    rng = random.Random(1234)
+    rng = make_rng(1234)
     for trial in range(200):
         dec = FrameDecoder()
         blob = bytes(rng.randrange(256) for _ in range(rng.randrange(1, 400)))
@@ -49,7 +61,7 @@ def test_decoder_corrupted_valid_frames():
     """Flip bytes inside structurally valid frames: either decodes, raises
     FramingError, or fails proto parse at the dispatch layer — never hangs
     or corrupts the stream position."""
-    rng = random.Random(99)
+    rng = make_rng(99)
     base = encode_frame(os.urandom(120), 0)
     for trial in range(300):
         corrupted = bytearray(base)
@@ -65,7 +77,7 @@ def test_decoder_corrupted_valid_frames():
 def test_connection_survives_hostile_packets():
     """Structurally valid frames with garbage protobuf bodies close or
     drop per policy; the process never raises to the caller."""
-    rng = random.Random(7)
+    rng = make_rng(7)
     for trial in range(100):
         t = FakeTransport()
         conn = add_connection(t, ConnectionType.CLIENT)
@@ -91,7 +103,7 @@ def test_handlers_survive_hostile_field_values():
     init_message_map()
     if get_channel(0) is None:
         create_channel(ChannelType.GLOBAL, None)
-    rng = random.Random(11)
+    rng = make_rng(11)
     t = FakeTransport()
     conn = add_connection(t, ConnectionType.CLIENT)
 
