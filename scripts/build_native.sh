@@ -9,3 +9,6 @@ g++ -O2 -fPIC -shared -std=c++17 \
     -l:libsnappy.so.1 -L/usr/lib/x86_64-linux-gnu \
     -o "channeld_tpu/native/_codec$EXT"
 echo "built: channeld_tpu/native/_codec$EXT"
+g++ -O2 -std=c++17 channeld_tpu/native/kcp_peer.cc \
+    -o channeld_tpu/native/kcp_peer
+echo "built: channeld_tpu/native/kcp_peer"
