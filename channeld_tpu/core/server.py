@@ -370,10 +370,12 @@ async def run_server(argv: Optional[list[str]] = None) -> None:
 
     from .metrics import serve_metrics
 
-    try:
-        serve_metrics(8080)
-    except OSError:
-        logger.warning("metrics port 8080 unavailable; /metrics disabled")
+    if global_settings.metrics_port:
+        try:
+            serve_metrics(global_settings.metrics_port)
+        except OSError:
+            logger.warning("metrics port %d unavailable; /metrics disabled",
+                           global_settings.metrics_port)
 
     tasks = [
         asyncio.ensure_future(flush_loop()),

@@ -117,6 +117,10 @@ class GlobalSettings:
     snapshot_path: str = ""
     snapshot_interval_s: float = 30.0
 
+    # Prometheus /metrics port (the reference hardcodes :8080,
+    # metrics.go; a flag lets N gateways share one host).
+    metrics_port: int = 8080
+
     # TPU decision-plane settings (new — no reference counterpart).
     spatial_backend: str = "host"  # "host" | "tpu"
     tpu_entity_capacity: int = 1 << 17
@@ -198,6 +202,8 @@ class GlobalSettings:
         p.add_argument("-snapshot", type=str, default="",
                        help="path for periodic gateway state snapshots; "
                             "restored at boot when present")
+        p.add_argument("-mport", type=int, default=self.metrics_port,
+                       help="Prometheus /metrics port (0 disables)")
         p.add_argument("-snapshot-interval", type=float,
                        default=self.snapshot_interval_s)
         p.add_argument("-spatial-backend", type=str, default=self.spatial_backend,
@@ -244,6 +250,7 @@ class GlobalSettings:
         self.tpu_mesh_hosts = args.mesh_hosts
         self.snapshot_path = args.snapshot
         self.snapshot_interval_s = args.snapshot_interval
+        self.metrics_port = args.mport
         self.import_modules = [m for m in args.imports.split(",") if m]
         self.load_channel_settings(args.chs)
 

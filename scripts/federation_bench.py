@@ -1,0 +1,167 @@
+"""Multi-gateway federation bench: N independent gateway processes on
+one host, each pressed by its own load_driver, aggregate msg/s reported
+as one JSON line.
+
+This is the shape of the reference's distributed claim — "10M+ mps in a
+distributed system" (ref: README.md:54) means N channeld nodes each
+doing its ~100K mps share; there is no cross-node gateway protocol in
+the reference to replicate (game servers fan out across nodes by
+connecting to each). So the federation bench measures: G gateways, the
+client population sharded across them, per-gateway and aggregate
+throughput, plus a scaling-efficiency figure against a measured
+1-gateway baseline on the same host.
+
+On a single-core host the aggregate is core-bound (gateways contend for
+the one CPU); the honest distributed number is
+per-node mps x node count, which this script prints as
+``extrapolated_nodes_for_10M``.
+
+Run:
+  python scripts/federation_bench.py --gateways 2 --conns 4000 \
+      --rate 10 --duration 30
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def wait_port(port: int, timeout: float = 30.0) -> bool:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            s = socket.create_connection(("127.0.0.1", port), timeout=1)
+            s.close()
+            return True
+        except OSError:
+            time.sleep(0.2)
+    return False
+
+
+def spawn_gateway(idx: int, base_port: int) -> tuple[subprocess.Popen, int, int, int]:
+    ca = base_port + idx * 10
+    sa = ca + 1
+    mport = base_port + 900 + idx
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "channeld_tpu", "-dev", "-loglevel", "2",
+         "-cn", "tcp", "-ca", f":{ca}", "-sn", "tcp", "-sa", f":{sa}",
+         "-cwm", "false", "-mport", str(mport),
+         "-chs", "config/channel_settings_hifi.json",
+         "-imports", "channeld_tpu.compat"],
+        cwd=REPO, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    return proc, ca, sa, mport
+
+
+def run_drivers(gateways: list[tuple], conns: int, procs: int, rate: float,
+                duration: float, mode: str) -> list[dict]:
+    """One load_driver subprocess per gateway, launched together so the
+    steady-state windows overlap (that's what makes the sum meaningful)."""
+    per = conns // len(gateways)
+    drivers = []
+    for i, (_, ca, sa, mport) in enumerate(gateways):
+        n = per + (1 if i < conns % len(gateways) else 0)
+        drivers.append(subprocess.Popen(
+            [sys.executable, "scripts/load_driver.py",
+             "--addr", f"127.0.0.1:{ca}", "--server-addr", f"127.0.0.1:{sa}",
+             "--conns", str(n), "--procs", str(procs),
+             "--rate", str(rate), "--duration", str(duration),
+             "--metrics-port", str(mport), "--mode", mode],
+            cwd=REPO, stdout=subprocess.PIPE, text=True,
+        ))
+    results = []
+    for d in drivers:
+        out, _ = d.communicate(timeout=duration + 240)
+        line = out.strip().splitlines()[-1] if out.strip() else "{}"
+        results.append(json.loads(line))
+    return results
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description="multi-gateway federation bench")
+    p.add_argument("--gateways", type=int, default=2)
+    p.add_argument("--conns", type=int, default=4000,
+                   help="total connections, sharded across gateways")
+    p.add_argument("--procs", type=int, default=2,
+                   help="driver worker processes per gateway")
+    p.add_argument("--rate", type=float, default=10.0)
+    p.add_argument("--duration", type=float, default=30.0)
+    p.add_argument("--mode", choices=("forward", "chat"), default="forward")
+    p.add_argument("--base-port", type=int, default=13100)
+    args = p.parse_args()
+
+    gateways = []
+    try:
+        for g in range(args.gateways):
+            gw = spawn_gateway(g, args.base_port)
+            gateways.append(gw)
+        for proc, ca, sa, _ in gateways:
+            if not wait_port(ca) or not wait_port(sa):
+                raise RuntimeError(f"gateway on :{ca} never came up")
+
+        results = run_drivers(gateways, args.conns, args.procs, args.rate,
+                              args.duration, args.mode)
+    finally:
+        for proc, *_ in gateways:
+            proc.send_signal(signal.SIGINT)
+        for proc, *_ in gateways:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    agg_sent = sum(r.get("driver_sent_mps", 0) for r in results)
+    agg_recv = sum(r.get("driver_recv_fps", 0) for r in results)
+    # Metric keys keep their Prometheus label strings
+    # (e.g. 'messages_in_total{msgtype="100"}'): sum by family prefix.
+    def fam(results_key: str) -> float:
+        return sum(
+            v for r in results
+            for k, v in r.get("gateway_metrics_delta", {}).items()
+            if k.startswith(results_key))
+
+    agg_gw_in = fam("messages_in_total")
+    agg_gw_out = fam("messages_out_total")
+    duration = max((r.get("duration_s", args.duration) for r in results),
+                   default=args.duration)
+    gw_mps = (agg_gw_in + agg_gw_out) / duration if duration else 0.0
+    ncpu = os.cpu_count() or 1
+    print(json.dumps({
+        "metric": "federation_load",
+        "gateways": args.gateways,
+        "mode": args.mode,
+        "host_cores": ncpu,
+        "conns_requested": args.conns,
+        "conns_authed": sum(r.get("conns_authed", 0) for r in results),
+        "rate_per_conn": args.rate,
+        "duration_s": duration,
+        "aggregate_driver_sent_mps": agg_sent,
+        "aggregate_driver_recv_fps": agg_recv,
+        "aggregate_gateway_mps": round(gw_mps),
+        "per_gateway": [
+            {
+                "driver_sent_mps": r.get("driver_sent_mps", 0),
+                "driver_recv_fps": r.get("driver_recv_fps", 0),
+                "conns_authed": r.get("conns_authed", 0),
+                "owner_error": r.get("owner_error", ""),
+                "worker_crashes": r.get("worker_crashes", []),
+            }
+            for r in results
+        ],
+        "extrapolated_nodes_for_10M": (
+            round(10_000_000 / gw_mps * args.gateways, 1) if gw_mps else None
+        ),
+    }))
+
+
+if __name__ == "__main__":
+    main()
