@@ -1,0 +1,27 @@
+#!/bin/sh
+# Build the C++ client SDK (static lib) + example. Run from anywhere.
+set -e
+cd "$(dirname "$0")"
+SDK_DIR=$(pwd)
+REPO=$(cd ../.. && pwd)
+mkdir -p gen
+# Generated C++ protos: the same .proto sources the gateway uses —
+# package chtpu (wire/control) + chatpb (compat family for the example).
+# Imports are repo-root-relative, so generate from the root and flatten
+# the output tree into gen/.
+(cd "$REPO" && protoc -I. -I/usr/include --cpp_out="$SDK_DIR/gen" \
+    channeld_tpu/protocol/wire.proto \
+    channeld_tpu/protocol/control.proto \
+    channeld_tpu/compat/chatpb.proto)
+GEN_PROTO="$SDK_DIR/gen/channeld_tpu/protocol"
+GEN_COMPAT="$SDK_DIR/gen/channeld_tpu/compat"
+CXXFLAGS="-O2 -std=c++17 -fPIC -I$SDK_DIR -I$SDK_DIR/gen"
+g++ $CXXFLAGS -c "$GEN_PROTO/wire.pb.cc" -o gen/wire.pb.o
+g++ $CXXFLAGS -c "$GEN_PROTO/control.pb.cc" -o gen/control.pb.o
+g++ $CXXFLAGS -c "$GEN_COMPAT/chatpb.pb.cc" -o gen/chatpb.pb.o
+g++ $CXXFLAGS -c channeld_client.cc -o channeld_client.o
+ar rcs libchanneld_client.a channeld_client.o gen/wire.pb.o gen/control.pb.o
+g++ $CXXFLAGS example_chat.cc libchanneld_client.a gen/chatpb.pb.o \
+    -lprotobuf -l:libsnappy.so.1 -L/usr/lib/x86_64-linux-gnu \
+    -o example_chat
+echo "built: sdk/cpp/libchanneld_client.a, sdk/cpp/example_chat"

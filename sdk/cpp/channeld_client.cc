@@ -1,0 +1,339 @@
+#include "channeld_client.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstring>
+
+#include "channeld_tpu/protocol/control.pb.h"
+
+// System libsnappy via its stable C ABI (no snappy-c.h in this image;
+// status: 0 = OK) — same approach as native/codec.cc.
+extern "C" {
+int snappy_uncompress(const char* compressed, size_t compressed_length,
+                      char* uncompressed, size_t* uncompressed_length);
+int snappy_uncompressed_length(const char* compressed,
+                               size_t compressed_length, size_t* result);
+}
+
+namespace chtpu_sdk {
+
+namespace {
+constexpr size_t kHeader = 5;
+constexpr size_t kMaxPacket = 0xFFFF;
+// Escaped sizes at/past the 0x48 ('H') tag collision are rejected, same
+// as the Python decoder (framing.py: the 0x48 byte-1 hole).
+constexpr size_t kExtendedHole = 0x480000;
+
+double MonoNow() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec + ts.tv_nsec * 1e-9;
+}
+}  // namespace
+
+ChanneldClient::ChanneldClient() { InstallDefaultHandlers(); }
+
+ChanneldClient::~ChanneldClient() {
+  if (fd_ >= 0) close(fd_);
+}
+
+bool ChanneldClient::Connect(const std::string& host, int port,
+                             double timeout_s) {
+  addrinfo hints{};
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  if (getaddrinfo(host.c_str(), std::to_string(port).c_str(), &hints, &res) !=
+          0 ||
+      res == nullptr) {
+    last_error_ = "resolve failed: " + host;
+    return false;
+  }
+  fd_ = socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+  if (fd_ < 0) {
+    freeaddrinfo(res);
+    last_error_ = "socket() failed";
+    return false;
+  }
+  timeval tv{};
+  tv.tv_sec = long(timeout_s);
+  tv.tv_usec = long((timeout_s - tv.tv_sec) * 1e6);
+  setsockopt(fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  setsockopt(fd_, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+  int one = 1;
+  setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  if (connect(fd_, res->ai_addr, res->ai_addrlen) != 0) {
+    last_error_ = std::string("connect failed: ") + strerror(errno);
+    freeaddrinfo(res);
+    close(fd_);
+    fd_ = -1;
+    return false;
+  }
+  freeaddrinfo(res);
+  connected_ = true;
+  return true;
+}
+
+void ChanneldClient::Disconnect() {
+  if (!connected_) return;
+  SendRaw(0, kDisconnect, "");
+  Flush();
+  close(fd_);
+  fd_ = -1;
+  connected_ = false;
+}
+
+void ChanneldClient::Auth(const std::string& pit,
+                          const std::string& login_token) {
+  chtpu::AuthMessage msg;
+  msg.set_playeridentifiertoken(pit);
+  msg.set_logintoken(login_token);
+  Send(0, kAuth, msg);
+}
+
+void ChanneldClient::SendRaw(uint32_t channel_id, uint32_t msg_type,
+                             const std::string& body, uint32_t broadcast,
+                             uint32_t stub_id) {
+  chtpu::MessagePack pack;
+  pack.set_channelid(channel_id);
+  pack.set_msgtype(msg_type);
+  pack.set_msgbody(body);
+  pack.set_broadcast(broadcast);
+  pack.set_stubid(stub_id);
+  outgoing_.push_back(std::move(pack));
+}
+
+void ChanneldClient::Send(uint32_t channel_id, uint32_t msg_type,
+                          const google::protobuf::Message& msg,
+                          uint32_t broadcast) {
+  SendRaw(channel_id, msg_type, msg.SerializeAsString(), broadcast, 0);
+}
+
+void ChanneldClient::SendWithCallback(uint32_t channel_id, uint32_t msg_type,
+                                      const google::protobuf::Message& msg,
+                                      MessageHandler callback,
+                                      uint32_t broadcast) {
+  uint32_t stub = next_stub_++;
+  if (next_stub_ == 0) next_stub_ = 1;
+  stub_callbacks_[stub] = std::move(callback);
+  SendRaw(channel_id, msg_type, msg.SerializeAsString(), broadcast, stub);
+}
+
+bool ChanneldClient::Flush() {
+  if (!connected_ || outgoing_.empty()) return connected_;
+  chtpu::Packet packet;
+  for (auto& pack : outgoing_)
+    *packet.add_messages() = std::move(pack);
+  outgoing_.clear();
+  std::string body = packet.SerializeAsString();
+  if (body.size() > kMaxPacket) {
+    // Over-cap batches split per message (each message is capped by the
+    // gateway anyway; a single oversized message is a protocol error).
+    for (const auto& pack : packet.messages()) {
+      chtpu::Packet single;
+      *single.add_messages() = pack;
+      std::string single_body = single.SerializeAsString();
+      if (single_body.size() > kMaxPacket) {
+        // Drop + record, like the Python SDK: an oversized message is a
+        // caller bug, not socket death — the connection stays usable
+        // and Tick()'s once-disconnected contract holds.
+        last_error_ = "message exceeds 64KB packet cap (dropped)";
+        continue;
+      }
+      std::string frame;
+      frame.reserve(kHeader + single_body.size());
+      frame.push_back('C');
+      frame.push_back('H');
+      frame.push_back(char((single_body.size() >> 8) & 0xFF));
+      frame.push_back(char(single_body.size() & 0xFF));
+      frame.push_back(0);  // no compression client->server
+      frame += single_body;
+      if (!WriteAll(frame)) return false;
+    }
+    return true;
+  }
+  std::string frame;
+  frame.reserve(kHeader + body.size());
+  frame.push_back('C');
+  frame.push_back('H');
+  frame.push_back(char((body.size() >> 8) & 0xFF));
+  frame.push_back(char(body.size() & 0xFF));
+  frame.push_back(0);
+  frame += body;
+  return WriteAll(frame);
+}
+
+bool ChanneldClient::WriteAll(const std::string& data) {
+  size_t off = 0;
+  while (off < data.size()) {
+    ssize_t n = send(fd_, data.data() + off, data.size() - off, MSG_NOSIGNAL);
+    if (n <= 0) {
+      last_error_ = std::string("send failed: ") + strerror(errno);
+      connected_ = false;
+      return false;
+    }
+    off += size_t(n);
+  }
+  return true;
+}
+
+void ChanneldClient::AddHandler(uint32_t msg_type, MessageHandler handler) {
+  handlers_.emplace(msg_type, std::move(handler));
+}
+
+bool ChanneldClient::Tick(double timeout_s) {
+  if (!connected_) return false;
+  if (!Flush()) return false;
+  if (ReadIntoBuffer(timeout_s)) DecodeAndDispatch();
+  return connected_;
+}
+
+bool ChanneldClient::WaitFor(uint32_t msg_type, double timeout_s,
+                             std::string* out) {
+  bool got = false;
+  auto it = handlers_.emplace(
+      msg_type, [&](uint32_t, const std::string& body) {
+        if (!got && out != nullptr) *out = body;
+        got = true;
+      });
+  double deadline = MonoNow() + timeout_s;
+  while (!got && connected_ && MonoNow() < deadline)
+    Tick(0.05);
+  handlers_.erase(it);
+  return got;
+}
+
+bool ChanneldClient::ReadIntoBuffer(double timeout_s) {
+  pollfd pfd{fd_, POLLIN, 0};
+  int ms = int(timeout_s * 1000.0);
+  if (poll(&pfd, 1, ms) <= 0) return false;
+  char buf[65536];
+  bool any = false;
+  while (true) {
+    ssize_t n = recv(fd_, buf, sizeof(buf), MSG_DONTWAIT);
+    if (n > 0) {
+      rbuf_.append(buf, size_t(n));
+      any = true;
+      continue;
+    }
+    if (n == 0) {
+      last_error_ = "peer closed";
+      connected_ = false;
+    }
+    break;  // n<0: EWOULDBLOCK (drained) or error surfaced on next send
+  }
+  return any;
+}
+
+void ChanneldClient::DecodeAndDispatch() {
+  size_t pos = 0;
+  while (rbuf_.size() - pos >= kHeader) {
+    const uint8_t* p = reinterpret_cast<const uint8_t*>(rbuf_.data()) + pos;
+    if (p[0] != 'C') {
+      last_error_ = "bad frame tag";
+      connected_ = false;
+      return;
+    }
+    size_t size;
+    if (p[1] != 'H') {
+      // Client-side 3-byte size escape: byte 1 carries the topmost size
+      // byte so server->client packets can exceed 64KB
+      // (ref: client.go:191-196; quirks documented in framing.py).
+      size = (size_t(p[1]) << 16) | (size_t(p[2]) << 8) | p[3];
+      if (size >= kExtendedHole) {
+        last_error_ = "extended frame in the 0x48 collision hole";
+        connected_ = false;
+        return;
+      }
+    } else {
+      size = (size_t(p[2]) << 8) | p[3];
+    }
+    if (size == 0) {
+      last_error_ = "zero-size frame";
+      connected_ = false;
+      return;
+    }
+    if (rbuf_.size() - pos < kHeader + size) break;  // partial frame
+    uint8_t ct = p[4];
+    std::string body(rbuf_, pos + kHeader, size);
+    pos += kHeader + size;
+    if (ct == 1) {
+      size_t out_len = 0;
+      if (snappy_uncompressed_length(body.data(), body.size(), &out_len) !=
+              0 ||
+          out_len > kExtendedHole * 4) {
+        last_error_ = "corrupt or bomb-sized snappy body";
+        connected_ = false;
+        return;
+      }
+      std::string raw(out_len, '\0');
+      if (snappy_uncompress(body.data(), body.size(), raw.data(), &out_len) !=
+          0) {
+        last_error_ = "snappy decompression failed";
+        connected_ = false;
+        return;
+      }
+      raw.resize(out_len);
+      body = std::move(raw);
+    }
+    chtpu::Packet packet;
+    if (!packet.ParseFromString(body)) {
+      last_error_ = "unparseable packet";
+      connected_ = false;
+      return;
+    }
+    for (const auto& pack : packet.messages()) {
+      if (pack.stubid() != 0) {
+        auto it = stub_callbacks_.find(pack.stubid());
+        if (it != stub_callbacks_.end()) {
+          it->second(pack.channelid(), pack.msgbody());
+          stub_callbacks_.erase(it);
+        }
+      }
+      auto range = handlers_.equal_range(pack.msgtype());
+      for (auto it = range.first; it != range.second; ++it)
+        it->second(pack.channelid(), pack.msgbody());
+    }
+  }
+  rbuf_.erase(0, pos);
+}
+
+void ChanneldClient::InstallDefaultHandlers() {
+  AddHandler(kAuth, [this](uint32_t, const std::string& body) {
+    chtpu::AuthResultMessage msg;
+    if (msg.ParseFromString(body) &&
+        msg.result() == chtpu::AuthResultMessage::SUCCESSFUL && conn_id_ == 0)
+      conn_id_ = msg.connid();
+  });
+  AddHandler(kCreateChannel, [this](uint32_t, const std::string& body) {
+    chtpu::CreateChannelResultMessage msg;
+    if (msg.ParseFromString(body) && msg.ownerconnid() == conn_id_)
+      created_.insert(msg.channelid());
+  });
+  AddHandler(kRemoveChannel, [this](uint32_t, const std::string& body) {
+    chtpu::RemoveChannelMessage msg;
+    if (msg.ParseFromString(body)) {
+      subs_.erase(msg.channelid());
+      created_.erase(msg.channelid());
+    }
+  });
+  AddHandler(kSubToChannel, [this](uint32_t ch, const std::string& body) {
+    chtpu::SubscribedToChannelResultMessage msg;
+    if (msg.ParseFromString(body) && msg.connid() == conn_id_)
+      subs_.insert(ch);
+  });
+  AddHandler(kUnsubFromChannel, [this](uint32_t ch, const std::string& body) {
+    chtpu::UnsubscribedFromChannelResultMessage msg;
+    if (msg.ParseFromString(body) && msg.connid() == conn_id_)
+      subs_.erase(ch);
+  });
+}
+
+}  // namespace chtpu_sdk

@@ -1,0 +1,123 @@
+// channeld-tpu C++ client SDK.
+//
+// Capability parity with the reference's native client surface — the UE
+// plugin's ChanneldConnection (ref: pkg/client/client.go for the wire
+// behavior; the reference's shipped native client lives in its UE
+// plugin) — as a dependency-light C++17 library over the same wire:
+// 5-byte 'C''H' tag framing, chtpu.Packet protobuf envelope, optional
+// snappy bodies, and the client-side 3-byte size escape that accepts
+// server packets past 64KB (ref: client.go:191-196).
+//
+// Design: blocking socket + a Tick() pump, mirroring the Python SDK
+// (channeld_tpu/client/client.py) so the two SDKs stay drop-in
+// equivalent: message-handler registry, stub-id RPC callbacks, outgoing
+// messages batched into one Packet per flush, default handlers tracking
+// subscribed/created channels.
+#pragma once
+
+#include <cstdint>
+#include <functional>
+#include <map>
+#include <memory>
+#include <set>
+#include <string>
+#include <vector>
+
+#include "channeld_tpu/protocol/wire.pb.h"
+
+namespace chtpu_sdk {
+
+// Matches channeld_tpu.core.types.MessageType / the reference enum.
+enum MessageType : uint32_t {
+  kAuth = 1,
+  kCreateChannel = 3,
+  kRemoveChannel = 4,
+  kListChannel = 5,
+  kSubToChannel = 6,
+  kUnsubFromChannel = 7,
+  kChannelDataUpdate = 8,
+  kDisconnect = 9,
+  kCreateSpatialChannel = 10,
+  kQuerySpatialChannel = 11,
+  kChannelDataHandover = 12,
+  kUserSpaceStart = 100,
+};
+
+// Bit flags; values match chtpu.BroadcastType (wire.proto:38-46).
+enum BroadcastType : uint32_t {
+  kNoBroadcast = 0,
+  kSingleConnection = 1,
+  kAll = 2,
+  kAllButSender = 4,
+  kAllButOwner = 8,
+  kAllButClient = 16,
+  kAllButServer = 32,
+};
+
+// (channel_id, raw message body). Register per msgType; parse the body
+// with the matching generated protobuf type (see ParseAs<T> below).
+using MessageHandler =
+    std::function<void(uint32_t channel_id, const std::string& body)>;
+
+class ChanneldClient {
+ public:
+  ChanneldClient();
+  ~ChanneldClient();
+
+  // TCP dial. Returns false (and sets last_error()) on failure.
+  bool Connect(const std::string& host, int port, double timeout_s = 5.0);
+  void Disconnect();  // sends DISCONNECT, closes the socket
+  bool connected() const { return connected_; }
+  uint32_t id() const { return conn_id_; }
+  const std::string& last_error() const { return last_error_; }
+
+  // ---- sending (queued; one Packet per Flush/Tick) ----
+  void Auth(const std::string& pit, const std::string& login_token);
+  void SendRaw(uint32_t channel_id, uint32_t msg_type,
+               const std::string& body, uint32_t broadcast = 0,
+               uint32_t stub_id = 0);
+  void Send(uint32_t channel_id, uint32_t msg_type,
+            const google::protobuf::Message& msg, uint32_t broadcast = 0);
+  // Send with a stub-id RPC callback fired on the correlated response.
+  void SendWithCallback(uint32_t channel_id, uint32_t msg_type,
+                        const google::protobuf::Message& msg,
+                        MessageHandler callback, uint32_t broadcast = 0);
+  bool Flush();  // write queued messages now; false on socket death
+
+  // ---- receiving ----
+  void AddHandler(uint32_t msg_type, MessageHandler handler);
+  // Pump: flush outgoing, read whatever arrives within timeout_s,
+  // dispatch handlers + stub callbacks. Returns false once disconnected.
+  bool Tick(double timeout_s = 0.0);
+  // Tick until a message of msg_type arrives; body returned via *out.
+  bool WaitFor(uint32_t msg_type, double timeout_s, std::string* out);
+
+  // Channel bookkeeping maintained by the default handlers.
+  const std::set<uint32_t>& subscribed_channels() const { return subs_; }
+  const std::set<uint32_t>& created_channels() const { return created_; }
+
+  template <typename T>
+  static bool ParseAs(const std::string& body, T* msg) {
+    return msg->ParseFromString(body);
+  }
+
+ private:
+  bool ReadIntoBuffer(double timeout_s);
+  void DecodeAndDispatch();
+  bool WriteAll(const std::string& data);
+  void InstallDefaultHandlers();
+
+  int fd_ = -1;
+  bool connected_ = false;
+  uint32_t conn_id_ = 0;
+  uint32_t next_stub_ = 1;
+  std::string last_error_;
+  std::string rbuf_;
+  std::vector<chtpu::MessagePack> outgoing_;
+  std::multimap<uint32_t, MessageHandler> handlers_;
+  std::map<uint32_t, MessageHandler> stub_callbacks_;
+  std::set<uint32_t> subs_;
+  std::set<uint32_t> created_;
+};
+
+}  // namespace chtpu_sdk

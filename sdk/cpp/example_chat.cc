@@ -1,0 +1,76 @@
+// End-to-end smoke for the C++ SDK against a live gateway: auth, create
+// + subscribe GLOBAL, publish a chat update, receive the fan-out back,
+// verify the content round-tripped. Prints CHAT_OK and exits 0 on
+// success. Mirrors examples/chat_rooms.py's core loop.
+#include <cstdio>
+#include <cstdlib>
+#include <string>
+
+#include "channeld_client.h"
+#include "channeld_tpu/compat/chatpb.pb.h"
+#include "channeld_tpu/protocol/control.pb.h"
+
+using chtpu_sdk::ChanneldClient;
+
+int fail(const ChanneldClient& c, const char* what) {
+  fprintf(stderr, "FAIL %s: %s\n", what, c.last_error().c_str());
+  return 1;
+}
+
+int main(int argc, char** argv) {
+  const char* host = argc > 1 ? argv[1] : "127.0.0.1";
+  int port = argc > 2 ? atoi(argv[2]) : 12108;
+
+  ChanneldClient client;
+  if (!client.Connect(host, port)) return fail(client, "connect");
+
+  client.Auth("cpp-sdk-smoke", "token");
+  std::string body;
+  if (!client.WaitFor(chtpu_sdk::kAuth, 10.0, &body))
+    return fail(client, "auth result");
+  chtpu::AuthResultMessage auth;
+  if (!auth.ParseFromString(body) ||
+      auth.result() != chtpu::AuthResultMessage::SUCCESSFUL)
+    return fail(client, "auth rejected");
+  printf("authed conn_id=%u\n", client.id());
+
+  // Create GLOBAL (possession; no-op result if already owned) then
+  // subscribe with write access.
+  chtpu::CreateChannelMessage create;
+  create.set_channeltype(chtpu::GLOBAL);
+  client.Send(0, chtpu_sdk::kCreateChannel, create);
+
+  chtpu::SubscribedToChannelMessage sub;
+  sub.mutable_suboptions()->set_dataaccess(chtpu::WRITE_ACCESS);
+  sub.mutable_suboptions()->set_fanoutintervalms(20);
+  client.Send(0, chtpu_sdk::kSubToChannel, sub);
+  if (!client.WaitFor(chtpu_sdk::kSubToChannel, 10.0, nullptr))
+    return fail(client, "sub result");
+
+  // Publish a chat message; the fan-out must deliver it back.
+  chatpb::ChatChannelData update;
+  auto* chat = update.add_chatmessages();
+  chat->set_sender("cpp-sdk");
+  chat->set_sendtime(1);
+  chat->set_content("hello from C++");
+  chtpu::ChannelDataUpdateMessage msg;
+  msg.mutable_data()->PackFrom(update);
+  client.Send(0, chtpu_sdk::kChannelDataUpdate, msg);
+
+  for (int i = 0; i < 200; i++) {
+    if (!client.WaitFor(chtpu_sdk::kChannelDataUpdate, 10.0, &body))
+      return fail(client, "fanout");
+    chtpu::ChannelDataUpdateMessage fan;
+    chatpb::ChatChannelData data;
+    if (fan.ParseFromString(body) && fan.data().UnpackTo(&data)) {
+      for (const auto& m : data.chatmessages()) {
+        if (m.sender() == "cpp-sdk" && m.content() == "hello from C++") {
+          printf("CHAT_OK\n");
+          client.Disconnect();
+          return 0;
+        }
+      }
+    }
+  }
+  return fail(client, "fanout never contained our message");
+}

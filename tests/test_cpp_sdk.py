@@ -1,0 +1,86 @@
+"""E2E test of the C++ client SDK (sdk/cpp) against a live gateway.
+
+The reference's native client surface is its UE C++ plugin; this SDK is
+the equivalent for channeld-tpu (ref: pkg/client/client.go wire
+behavior). The smoke binary connects over TCP, auths, creates +
+subscribes GLOBAL with write access, publishes a chatpb update, and
+verifies the fan-out delivers the content back — the full client loop
+through real sockets, framing, protobuf, and the gateway's merge+fanout
+path.
+"""
+
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+SDK = REPO / "sdk" / "cpp"
+
+
+@pytest.fixture(scope="module")
+def example_bin():
+    binary = SDK / "example_chat"
+    newest_src = max(
+        p.stat().st_mtime
+        for p in (SDK / "channeld_client.cc", SDK / "channeld_client.h",
+                  SDK / "example_chat.cc")
+    )
+    if not binary.exists() or binary.stat().st_mtime < newest_src:
+        proc = subprocess.run(["sh", str(SDK / "build.sh")],
+                              capture_output=True, text=True)
+        if proc.returncode != 0:
+            pytest.skip(f"C++ SDK build failed: {proc.stderr[-300:]}")
+    return str(binary)
+
+
+def _free_tcp_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_cpp_sdk_chat_roundtrip(example_bin, tmp_path):
+    ca, sa = _free_tcp_port(), _free_tcp_port()
+    # Gateway output goes to a file, not a pipe: an unread PIPE fills at
+    # ~64KB of info-level logs and deadlocks the gateway mid-test.
+    gw_log = open(tmp_path / "gateway.log", "w+")
+    gw = subprocess.Popen(
+        [sys.executable, "-m", "channeld_tpu", "-dev", "-loglevel", "0",
+         "-cn", "tcp", "-ca", f":{ca}", "-sn", "tcp", "-sa", f":{sa}",
+         "-cwm", "false", "-cfsm", "config/client_authoritative_fsm.json",
+         "-mport", "0", "-imports", "channeld_tpu.compat"],
+        cwd=REPO, stdout=gw_log, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            try:
+                socket.create_connection(("127.0.0.1", ca), timeout=1).close()
+                break
+            except OSError:
+                time.sleep(0.3)
+        else:
+            pytest.fail("gateway never started listening")
+        proc = subprocess.run([example_bin, "127.0.0.1", str(ca)],
+                              capture_output=True, text=True, timeout=60)
+        if proc.returncode != 0:
+            gw_log.flush()
+            gw_log.seek(0)
+            pytest.fail(
+                f"C++ SDK smoke failed: {proc.stdout} {proc.stderr}\n"
+                f"gateway log tail:\n{gw_log.read()[-2000:]}"
+            )
+        assert "CHAT_OK" in proc.stdout
+    finally:
+        gw.terminate()
+        try:
+            gw.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            gw.kill()
+        gw_log.close()
