@@ -128,15 +128,21 @@ class SpatialEngine:
         self._sub_last_dirty: set[int] = set()  # last-fan-out column
 
         # Device state (entity arrays sharded over the mesh when given).
+        # .copy(): jax's H2D transfer is async and may read the numpy
+        # buffer after this call; _positions/_valid are mutated by
+        # add/update_entity before the first tick, so the live buffers
+        # must never be handed to the transfer (see _flush_host_state).
         if self._entity_ns is not None:
-            self._d_positions = jax.device_put(self._positions, self._entity_ns)
-            self._d_valid = jax.device_put(self._valid, self._entity_ns)
+            self._d_positions = jax.device_put(
+                self._positions.copy(), self._entity_ns
+            )
+            self._d_valid = jax.device_put(self._valid.copy(), self._entity_ns)
             self._d_cell = jax.device_put(
                 np.full(entity_capacity, -1, np.int32), self._entity_ns
             )
         else:
-            self._d_positions = jnp.asarray(self._positions)
-            self._d_valid = jnp.asarray(self._valid)
+            self._d_positions = jnp.asarray(self._positions.copy())
+            self._d_valid = jnp.asarray(self._valid.copy())
             self._d_cell = jnp.full(entity_capacity, -1, jnp.int32)
         self._d_queries: Optional[QuerySet] = None
         self._d_sub_state = None
@@ -341,7 +347,8 @@ class SpatialEngine:
         spots_changed = False
         if self._q_spot_dist is not None:
             if self._d_spot_dist is None:
-                self._d_spot_dist = jnp.asarray(self._q_spot_dist)
+                # .copy(): async H2D vs later host row writes (below).
+                self._d_spot_dist = jnp.asarray(self._q_spot_dist.copy())
                 self._spot_dirty_rows.clear()
                 spots_changed = True
             elif self._spot_dirty_rows:
@@ -354,20 +361,27 @@ class SpatialEngine:
                 self._spot_dirty_rows.clear()
                 spots_changed = True
         if self._d_queries is None or self._queries_dirty or spots_changed:
+            # .copy(): jax's H2D transfer of a numpy array is async and
+            # may read the buffer AFTER this call returns; these staging
+            # arrays are mutated by later set_query/remove_query calls,
+            # so handing jax the live buffer races host writes against
+            # the deferred copy (observed on a loaded host as a query
+            # table whose slot read as cleared one tick early).
             self._d_queries = QuerySet(
-                jnp.asarray(self._q_kind),
-                jnp.asarray(self._q_center),
-                jnp.asarray(self._q_extent),
-                jnp.asarray(self._q_dir),
-                jnp.asarray(self._q_angle),
+                jnp.asarray(self._q_kind.copy()),
+                jnp.asarray(self._q_center.copy()),
+                jnp.asarray(self._q_extent.copy()),
+                jnp.asarray(self._q_dir.copy()),
+                jnp.asarray(self._q_angle.copy()),
                 self._d_spot_dist,
             )
             self._queries_dirty = False
         if self._d_sub_state is None:
+            # .copy(): async H2D vs later host writes to these mirrors.
             self._d_sub_state = (
-                jnp.asarray(self._sub_last),
-                jnp.asarray(self._sub_interval),
-                jnp.asarray(self._sub_active),
+                jnp.asarray(self._sub_last.copy()),
+                jnp.asarray(self._sub_interval.copy()),
+                jnp.asarray(self._sub_active.copy()),
             )
             self._sub_dirty_slots.clear()
             self._sub_last_dirty.clear()

@@ -456,15 +456,40 @@ def test_engine_mesh_matches_single_device():
                                sharding=sharding)
         res_s = _drive_engine(single, np.random.default_rng(42))
         res_m = _drive_engine(meshed, np.random.default_rng(42))
-        for out_s, out_m in zip(res_s, res_m):
+        for tick, (out_s, out_m) in enumerate(zip(res_s, res_m)):
+            ctx = f"sharding={sharding} mesh={mesh.shape} tick={tick}"
+            if not np.array_equal(np.asarray(out_s["interest"]),
+                                  np.asarray(out_m["interest"])):
+                # Flake forensics: persist everything needed to replay
+                # the divergent element offline (the mismatch has been
+                # a 1-element boundary diff; the dump pins which side
+                # and which geometry).
+                np.savez(
+                    "/tmp/mesh_parity_dump.npz",
+                    interest_s=np.asarray(out_s["interest"]),
+                    interest_m=np.asarray(out_m["interest"]),
+                    dist_s=np.asarray(out_s["dist"]),
+                    dist_m=np.asarray(out_m["dist"]),
+                    q_kind=single._q_kind, q_center=single._q_center,
+                    q_extent=single._q_extent, q_dir=single._q_dir,
+                    q_angle=single._q_angle,
+                    mq_kind=meshed._q_kind, mq_center=meshed._q_center,
+                    mq_extent=meshed._q_extent, mq_dir=meshed._q_dir,
+                    mq_angle=meshed._q_angle,
+                    ctx=np.array(ctx),
+                )
             np.testing.assert_array_equal(
-                np.asarray(out_s["cell_of"]), np.asarray(out_m["cell_of"]))
+                np.asarray(out_s["cell_of"]), np.asarray(out_m["cell_of"]),
+                err_msg=ctx)
             np.testing.assert_array_equal(
-                np.asarray(out_s["cell_counts"]), np.asarray(out_m["cell_counts"]))
+                np.asarray(out_s["cell_counts"]),
+                np.asarray(out_m["cell_counts"]), err_msg=ctx)
             np.testing.assert_array_equal(
-                np.asarray(out_s["interest"]), np.asarray(out_m["interest"]))
+                np.asarray(out_s["interest"]), np.asarray(out_m["interest"]),
+                err_msg=ctx)
             np.testing.assert_array_equal(
-                np.asarray(out_s["due"]), np.asarray(out_m["due"]))
+                np.asarray(out_s["due"]), np.asarray(out_m["due"]),
+                err_msg=ctx)
             # Handover rows may differ in order (per-shard compaction);
             # compare as sets of (slot, src, dst).
             ho_s = {tuple(r) for r in np.asarray(
