@@ -4,6 +4,9 @@ clients recorded against the reference resolve their Any type URLs here.
 """
 
 from . import chatpb_pb2  # noqa: F401  (registers chatpb.* in the symbol db)
+from . import unitypb_pb2  # noqa: F401  (channeldpb.Vector3f/4f, TransformState
+#   — the reference's unity_common.proto types, so Unity-SDK Any payloads
+#   resolve; ref: pkg/channeldpb/unity_common.proto)
 
 from ..models.chat import attach_chat_merge
 

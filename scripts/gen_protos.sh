@@ -12,5 +12,6 @@ protoc -I. -I/usr/include --python_out=. \
     channeld_tpu/ops/service.proto \
     channeld_tpu/compat/chatpb.proto \
     channeld_tpu/compat/unrealpb.proto \
+    channeld_tpu/compat/unitypb.proto \
     channeld_tpu/protocol/snapshot.proto
 echo "generated: channeld_tpu/protocol/*_pb2.py"

@@ -344,3 +344,26 @@ def test_ue_destroy_removes_entity_and_channel():
     MESSAGE_MAP[MSG_DESTROY].handler(ctx)
     assert net_guid not in ch.get_data_message().entities
     assert get_channel(net_guid) is None or get_channel(net_guid).is_removing()
+
+
+def test_unitypb_types_resolve_from_any():
+    """The Unity family (channeldpb.Vector3f/4f, TransformState — ref:
+    pkg/channeldpb/unity_common.proto) registers in the symbol db so a
+    Unity SDK's Any payloads resolve by type URL on this gateway."""
+    from channeld_tpu.compat import unitypb_pb2
+    from channeld_tpu.utils.anyutil import pack_any, unpack_any
+
+    t = unitypb_pb2.TransformState()
+    t.position.x = 1.5
+    t.position.z = -3.25
+    t.rotation.w = 1.0
+    t.scale.y = 2.0
+    packed = pack_any(t)
+    assert packed.type_url.endswith("channeldpb.TransformState")
+    out = unpack_any(packed)
+    assert type(out).DESCRIPTOR.full_name == "channeldpb.TransformState"
+    assert out.position.x == 1.5 and out.position.z == -3.25
+    assert out.rotation.w == 1.0 and out.scale.y == 2.0
+    # removed-marker field number matches the reference (field 1).
+    t2 = unitypb_pb2.TransformState(removed=True)
+    assert t2.SerializeToString() == b"\x08\x01"
