@@ -128,3 +128,20 @@ def test_replay_harness_end_to_end(tmp_path):
     assert stats["packets_sent"] >= 9  # 3 conns x (auth + sub + 2 upd) - dups
     assert stats["messages_received"] > 0  # fan-outs made it back
     assert len(set(rewrote)) == 3  # every connection got its own rewrite
+
+
+def test_replay_cli_dump(capsys):
+    """python -m channeld_tpu.replay dump <cpr> summarizes the session."""
+    from channeld_tpu.replay.__main__ import main
+
+    rc = main(["dump", "examples/sessions/chat_demo.cpr"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "AUTH" in out and "CHANNEL_DATA_UPDATE" in out
+    assert "msgType histogram" in out
+
+
+def test_replay_cli_usage(capsys):
+    from channeld_tpu.replay.__main__ import main
+
+    assert main([]) == 64
