@@ -12,7 +12,9 @@
 // It deliberately shares no code or structure with the Python side: a
 // C-style single-threaded poll loop with array-backed windows, so that
 // any behavioral agreement between the two is evidence about the wire
-// contract, not about shared bugs.
+// contract, not about shared bugs. (The ARQ itself lives in
+// sdk/cpp/kcp_conv.h, shared with the C++ SDK's KCP transport — both
+// are the same independent C++ lineage.)
 //
 // Modes:
 //   kcp_peer echo <port>
@@ -40,239 +42,12 @@
 #include <string>
 #include <vector>
 
+#include "../../sdk/cpp/kcp_conv.h"
+
 namespace {
 
-constexpr int kHeader = 24;
-constexpr int kMtu = 1400;
-constexpr int kSegPayload = kMtu - kHeader;
-constexpr uint8_t kPush = 81, kAck = 82, kWask = 83, kWins = 84;
-constexpr uint32_t kRcvWnd = 256, kSndWnd = 256;
-constexpr double kRtoMin = 0.03, kRtoDef = 0.2, kRtoMax = 6.0;
-constexpr int kFastResend = 3;
-constexpr int kDeadLink = 64;  // torture links retransmit a lot; be patient
+using namespace chtpu_kcp;
 
-double mono_now() {
-  struct timespec ts;
-  clock_gettime(CLOCK_MONOTONIC, &ts);
-  return ts.tv_sec + ts.tv_nsec * 1e-9;
-}
-
-void put32(uint8_t* p, uint32_t v) {
-  p[0] = v & 0xff; p[1] = (v >> 8) & 0xff;
-  p[2] = (v >> 16) & 0xff; p[3] = (v >> 24) & 0xff;
-}
-void put16(uint8_t* p, uint16_t v) { p[0] = v & 0xff; p[1] = (v >> 8) & 0xff; }
-uint32_t get32(const uint8_t* p) {
-  return p[0] | (p[1] << 8) | (p[2] << 16) | (uint32_t(p[3]) << 24);
-}
-uint16_t get16(const uint8_t* p) { return p[0] | (p[1] << 8); }
-
-struct InFlight {
-  std::vector<uint8_t> data;
-  double resend_at = 0;
-  double rto = kRtoDef;
-  int xmit = 0;
-  int fastack = 0;
-  uint32_t ts = 0;
-};
-
-// One KCP conversation endpoint over a connected/addressed UDP socket.
-struct Conv {
-  uint32_t conv = 0;
-  int fd = -1;
-  sockaddr_in peer{};
-  bool have_peer = false;
-  double t0 = mono_now();
-
-  // send side
-  uint32_t snd_una = 0, snd_nxt = 0;
-  std::map<uint32_t, InFlight> flight;
-  std::deque<std::vector<uint8_t>> sendq;
-  uint32_t rmt_wnd = 32;
-  double srtt = 0, rttvar = 0, rto = kRtoDef;
-  double probe_at = 0;
-  bool send_wins = false;
-  bool dead = false;
-
-  // receive side
-  uint32_t rcv_nxt = 0;
-  std::map<uint32_t, std::vector<uint8_t>> rcv_buf;
-  std::vector<std::pair<uint32_t, uint32_t>> acks;  // (sn, ts-echo)
-  std::vector<uint8_t> stream_in;
-
-  uint32_t now_ms() const {
-    return uint32_t((mono_now() - t0) * 1000.0);
-  }
-  uint32_t wnd_unused() const {
-    size_t used = rcv_buf.size();
-    return used >= kRcvWnd ? 0 : uint32_t(kRcvWnd - used);
-  }
-
-  void tx(const uint8_t* buf, size_t n) {
-    if (have_peer)
-      sendto(fd, buf, n, 0, reinterpret_cast<const sockaddr*>(&peer),
-             sizeof(peer));
-    else
-      send(fd, buf, n, 0);
-  }
-
-  void emit_seg(std::vector<uint8_t>& dgram, uint8_t cmd, uint32_t ts,
-                uint32_t sn, const uint8_t* payload, uint32_t len) {
-    if (!dgram.empty() && dgram.size() + kHeader + len > kMtu) {
-      tx(dgram.data(), dgram.size());
-      dgram.clear();
-    }
-    size_t off = dgram.size();
-    dgram.resize(off + kHeader + len);
-    uint8_t* p = dgram.data() + off;
-    put32(p, conv);
-    p[4] = cmd;
-    p[5] = 0;  // frg: stream mode
-    put16(p + 6, uint16_t(wnd_unused()));
-    put32(p + 8, ts);
-    put32(p + 12, sn);
-    put32(p + 16, rcv_nxt);
-    put32(p + 20, len);
-    if (len) memcpy(p + kHeader, payload, len);
-  }
-
-  void queue_stream(const uint8_t* data, size_t n) {
-    for (size_t off = 0; off < n; off += kSegPayload) {
-      size_t len = std::min(size_t(kSegPayload), n - off);
-      sendq.emplace_back(data + off, data + off + len);
-    }
-  }
-
-  void flush() {
-    double now = mono_now();
-    uint32_t nms = now_ms();
-    std::vector<uint8_t> dgram;
-
-    for (auto& a : acks) emit_seg(dgram, kAck, a.second, a.first, nullptr, 0);
-    acks.clear();
-
-    if (rmt_wnd == 0 && now >= probe_at) {
-      emit_seg(dgram, kWask, nms, 0, nullptr, 0);
-      probe_at = now + 0.5;
-    }
-    if (send_wins) {
-      emit_seg(dgram, kWins, nms, 0, nullptr, 0);
-      send_wins = false;
-    }
-
-    uint32_t cwnd = std::min(kSndWnd, rmt_wnd);
-    while (!sendq.empty() && snd_nxt < snd_una + cwnd) {
-      InFlight f;
-      f.data = std::move(sendq.front());
-      sendq.pop_front();
-      f.ts = nms;
-      f.rto = rto;
-      f.resend_at = now + f.rto;
-      f.xmit = 1;
-      emit_seg(dgram, kPush, f.ts, snd_nxt, f.data.data(),
-               uint32_t(f.data.size()));
-      flight.emplace(snd_nxt, std::move(f));
-      snd_nxt++;
-    }
-
-    for (auto& [sn, f] : flight) {
-      bool need = false;
-      if (now >= f.resend_at) {
-        need = true;
-        f.rto = std::min(f.rto * 1.5, kRtoMax);
-      } else if (f.fastack >= kFastResend) {
-        need = true;
-        f.fastack = 0;
-      }
-      if (need) {
-        f.xmit++;
-        f.ts = nms;
-        f.resend_at = now + f.rto;
-        emit_seg(dgram, kPush, f.ts, sn, f.data.data(),
-                 uint32_t(f.data.size()));
-        if (f.xmit >= kDeadLink) dead = true;
-      }
-    }
-    if (!dgram.empty()) tx(dgram.data(), dgram.size());
-  }
-
-  void on_ack_rtt(uint32_t ts_echo) {
-    double rtt = (double)((now_ms() - ts_echo) & 0xffffffffu) / 1000.0;
-    if (rtt < 0 || rtt > 60) return;
-    if (srtt == 0) {
-      srtt = rtt;
-      rttvar = rtt / 2;
-    } else {
-      double d = rtt > srtt ? rtt - srtt : srtt - rtt;
-      rttvar = 0.75 * rttvar + 0.25 * d;
-      srtt = 0.875 * srtt + 0.125 * rtt;
-    }
-    double cand = srtt + std::max(0.01, 4 * rttvar);
-    rto = std::min(std::max(kRtoMin, cand), kRtoMax);
-  }
-
-  // Feed one datagram. Returns false if it doesn't belong to this conv.
-  bool input(const uint8_t* data, size_t n) {
-    // Pre-pass mirroring the Python side's contract exactly: parsing
-    // stops at the first truncated/unknown-cmd segment (the valid
-    // prefix IS applied), but a conv mismatch anywhere in the parsed
-    // prefix drops the datagram wholesale before any state is touched.
-    size_t parse_end = 0;
-    {
-      size_t pos = 0;
-      while (n - pos >= kHeader) {
-        const uint8_t* p = data + pos;
-        uint8_t cmd = p[4];
-        uint32_t len = get32(p + 20);
-        if (cmd < kPush || cmd > kWins || len > n - pos - kHeader) break;
-        if (get32(p) != conv) return false;
-        pos += kHeader + len;
-      }
-      parse_end = pos;
-    }
-    size_t pos = 0;
-    while (pos < parse_end) {
-      const uint8_t* p = data + pos;
-      uint8_t cmd = p[4];
-      uint16_t wnd = get16(p + 6);
-      uint32_t ts = get32(p + 8), sn = get32(p + 12), una = get32(p + 16);
-      uint32_t len = get32(p + 20);
-      pos += kHeader + len;
-
-      rmt_wnd = wnd;
-      if (una > snd_una) {
-        flight.erase(flight.begin(), flight.lower_bound(una));
-        snd_una = una;
-      }
-      if (cmd == kAck) {
-        auto it = flight.find(sn);
-        if (it != flight.end()) {
-          if (it->second.xmit == 1) on_ack_rtt(ts);  // Karn's rule
-          flight.erase(it);
-        }
-        for (auto& [s, f] : flight)
-          if (s < sn) f.fastack++;
-        while (snd_una < snd_nxt && !flight.count(snd_una)) snd_una++;
-      } else if (cmd == kPush) {
-        if (sn < rcv_nxt + kRcvWnd) acks.emplace_back(sn, ts);
-        if (sn >= rcv_nxt && sn < rcv_nxt + kRcvWnd)
-          rcv_buf.emplace(sn, std::vector<uint8_t>(p + kHeader,
-                                                   p + kHeader + len));
-        while (true) {
-          auto it = rcv_buf.find(rcv_nxt);
-          if (it == rcv_buf.end()) break;
-          stream_in.insert(stream_in.end(), it->second.begin(),
-                           it->second.end());
-          rcv_buf.erase(it);
-          rcv_nxt++;
-        }
-      } else if (cmd == kWask) {
-        send_wins = true;
-      }  // kWins: window already applied from wnd
-    }
-    return true;
-  }
-};
 
 uint32_t xorshift(uint32_t& s) {
   s ^= s << 13;

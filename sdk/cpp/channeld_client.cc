@@ -10,8 +10,10 @@
 
 #include <cerrno>
 #include <cstring>
+#include <random>
 
 #include "channeld_tpu/protocol/control.pb.h"
+#include "kcp_conv.h"
 
 // System libsnappy via its stable C ABI (no snappy-c.h in this image;
 // status: 0 = OK) — same approach as native/codec.cc.
@@ -37,6 +39,11 @@ double MonoNow() {
   return ts.tv_sec + ts.tv_nsec * 1e-9;
 }
 }  // namespace
+
+// The KCP conversation state (kept out of the public header).
+struct ChanneldClient::KcpState {
+  chtpu_kcp::Conv conv;
+};
 
 ChanneldClient::ChanneldClient() { InstallDefaultHandlers(); }
 
@@ -81,6 +88,38 @@ bool ChanneldClient::Connect(const std::string& host, int port,
   return true;
 }
 
+bool ChanneldClient::ConnectKcp(const std::string& host, int port,
+                                double timeout_s) {
+  addrinfo hints{};
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_DGRAM;
+  addrinfo* res = nullptr;
+  if (getaddrinfo(host.c_str(), std::to_string(port).c_str(), &hints, &res) !=
+          0 ||
+      res == nullptr) {
+    last_error_ = "resolve failed: " + host;
+    return false;
+  }
+  fd_ = socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+  if (fd_ < 0 || connect(fd_, res->ai_addr, res->ai_addrlen) != 0) {
+    last_error_ = std::string("kcp connect failed: ") + strerror(errno);
+    freeaddrinfo(res);
+    if (fd_ >= 0) close(fd_);
+    fd_ = -1;
+    return false;
+  }
+  freeaddrinfo(res);
+  kcp_ = std::make_unique<KcpState>();
+  // Random conv like kcp-go's DialWithOptions (and the Python client);
+  // the gateway opens the session on our first PUSH sn==0.
+  std::random_device rd;
+  kcp_->conv.conv = (uint32_t(rd()) | 1);
+  kcp_->conv.fd = fd_;
+  (void)timeout_s;  // KCP supplies its own retransmission timers
+  connected_ = true;
+  return true;
+}
+
 void ChanneldClient::Disconnect() {
   if (!connected_) return;
   SendRaw(0, kDisconnect, "");
@@ -88,6 +127,7 @@ void ChanneldClient::Disconnect() {
   close(fd_);
   fd_ = -1;
   connected_ = false;
+  kcp_.reset();  // a later Connect() must not revive the KCP path
 }
 
 void ChanneldClient::Auth(const std::string& pit,
@@ -171,6 +211,19 @@ bool ChanneldClient::Flush() {
 }
 
 bool ChanneldClient::WriteAll(const std::string& data) {
+  if (kcp_) {
+    // The framed byte stream rides the ARQ; datagrams go out via
+    // conv.flush() (window-permitting) and retransmit on timers.
+    kcp_->conv.queue_stream(
+        reinterpret_cast<const uint8_t*>(data.data()), data.size());
+    kcp_->conv.flush();
+    if (kcp_->conv.dead) {
+      last_error_ = "kcp dead link";
+      connected_ = false;
+      return false;
+    }
+    return true;
+  }
   size_t off = 0;
   while (off < data.size()) {
     ssize_t n = send(fd_, data.data() + off, data.size() - off, MSG_NOSIGNAL);
@@ -213,9 +266,41 @@ bool ChanneldClient::WaitFor(uint32_t msg_type, double timeout_s,
 bool ChanneldClient::ReadIntoBuffer(double timeout_s) {
   pollfd pfd{fd_, POLLIN, 0};
   int ms = int(timeout_s * 1000.0);
-  if (poll(&pfd, 1, ms) <= 0) return false;
+  if (kcp_) {
+    // Cap the wait at the nearest retransmit deadline: on a silent
+    // link poll() would otherwise stall RTO-due retransmits for the
+    // caller's whole Tick timeout.
+    double wait = kcp_->conv.next_timer_s();
+    int timer_ms = wait < 0 ? ms : int(wait * 1000.0) + 1;
+    if (timer_ms < ms || ms < 0) ms = std::max(timer_ms, 0);
+  }
+  int ready = poll(&pfd, 1, ms);
   char buf[65536];
   bool any = false;
+  if (kcp_) {
+    if (ready > 0) {
+      while (true) {
+        ssize_t n = recv(fd_, buf, sizeof(buf), MSG_DONTWAIT);
+        if (n < chtpu_kcp::kHeader) break;
+        kcp_->conv.input(reinterpret_cast<const uint8_t*>(buf), size_t(n));
+      }
+    }
+    // Timer duties even on idle polls: acks, retransmits, probes.
+    kcp_->conv.flush();
+    if (kcp_->conv.dead) {
+      last_error_ = "kcp dead link";
+      connected_ = false;
+      return false;
+    }
+    auto& in = kcp_->conv.stream_in;
+    if (!in.empty()) {
+      rbuf_.append(reinterpret_cast<const char*>(in.data()), in.size());
+      in.clear();
+      any = true;
+    }
+    return any;
+  }
+  if (ready <= 0) return false;
   while (true) {
     ssize_t n = recv(fd_, buf, sizeof(buf), MSG_DONTWAIT);
     if (n > 0) {

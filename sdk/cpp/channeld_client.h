@@ -66,6 +66,9 @@ class ChanneldClient {
 
   // TCP dial. Returns false (and sets last_error()) on failure.
   bool Connect(const std::string& host, int port, double timeout_s = 5.0);
+  // KCP dial (UDP; the reference's -cn kcp listener). Same API surface —
+  // the framed byte stream rides the KCP ARQ (sdk/cpp/kcp_conv.h).
+  bool ConnectKcp(const std::string& host, int port, double timeout_s = 5.0);
   void Disconnect();  // sends DISCONNECT, closes the socket
   bool connected() const { return connected_; }
   uint32_t id() const { return conn_id_; }
@@ -107,6 +110,8 @@ class ChanneldClient {
   bool WriteAll(const std::string& data);
   void InstallDefaultHandlers();
 
+  struct KcpState;  // defined in the .cc (keeps kcp_conv.h out of users)
+  std::unique_ptr<KcpState> kcp_;
   int fd_ = -1;
   bool connected_ = false;
   uint32_t conn_id_ = 0;

@@ -20,9 +20,13 @@ int fail(const ChanneldClient& c, const char* what) {
 int main(int argc, char** argv) {
   const char* host = argc > 1 ? argv[1] : "127.0.0.1";
   int port = argc > 2 ? atoi(argv[2]) : 12108;
+  const char* transport = argc > 3 ? argv[3] : "tcp";
 
   ChanneldClient client;
-  if (!client.Connect(host, port)) return fail(client, "connect");
+  bool ok = std::string(transport) == "kcp"
+                ? client.ConnectKcp(host, port)
+                : client.Connect(host, port);
+  if (!ok) return fail(client, "connect");
 
   client.Auth("cpp-sdk-smoke", "token");
   std::string body;

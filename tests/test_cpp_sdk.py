@@ -45,30 +45,37 @@ def _free_tcp_port() -> int:
     return port
 
 
-def test_cpp_sdk_chat_roundtrip(example_bin, tmp_path):
+@pytest.mark.parametrize("transport", ["tcp", "kcp"])
+def test_cpp_sdk_chat_roundtrip(example_bin, tmp_path, transport):
     ca, sa = _free_tcp_port(), _free_tcp_port()
     # Gateway output goes to a file, not a pipe: an unread PIPE fills at
     # ~64KB of info-level logs and deadlocks the gateway mid-test.
     gw_log = open(tmp_path / "gateway.log", "w+")
     gw = subprocess.Popen(
         [sys.executable, "-m", "channeld_tpu", "-dev", "-loglevel", "0",
-         "-cn", "tcp", "-ca", f":{ca}", "-sn", "tcp", "-sa", f":{sa}",
+         "-cn", transport, "-ca", f":{ca}", "-sn", "tcp", "-sa", f":{sa}",
          "-cwm", "false", "-cfsm", "config/client_authoritative_fsm.json",
          "-mport", "0", "-imports", "channeld_tpu.compat"],
         cwd=REPO, stdout=gw_log, stderr=subprocess.STDOUT, text=True,
     )
     try:
+        # TCP probes the client listener directly; for kcp (UDP client
+        # listener) probe the TCP SERVER listener — the KCP client's ARQ
+        # retransmits the handshake until the UDP port appears.
+        probe = ca if transport == "tcp" else sa
         deadline = time.monotonic() + 30
         while time.monotonic() < deadline:
             try:
-                socket.create_connection(("127.0.0.1", ca), timeout=1).close()
+                socket.create_connection(
+                    ("127.0.0.1", probe), timeout=1).close()
                 break
             except OSError:
                 time.sleep(0.3)
         else:
             pytest.fail("gateway never started listening")
-        proc = subprocess.run([example_bin, "127.0.0.1", str(ca)],
-                              capture_output=True, text=True, timeout=60)
+        proc = subprocess.run(
+            [example_bin, "127.0.0.1", str(ca), transport],
+            capture_output=True, text=True, timeout=60)
         if proc.returncode != 0:
             gw_log.flush()
             gw_log.seek(0)
