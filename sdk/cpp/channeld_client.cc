@@ -18,11 +18,42 @@
 // System libsnappy via its stable C ABI (no snappy-c.h in this image;
 // status: 0 = OK) — same approach as native/codec.cc.
 extern "C" {
+int snappy_compress(const char* input, size_t input_length, char* compressed,
+                    size_t* compressed_length);
+size_t snappy_max_compressed_length(size_t source_length);
 int snappy_uncompress(const char* compressed, size_t compressed_length,
                       char* uncompressed, size_t* uncompressed_length);
 int snappy_uncompressed_length(const char* compressed,
                                size_t compressed_length, size_t* result);
 }
+
+namespace {
+// Frame = 5-byte tag + body; snappy applied when negotiated AND smaller
+// (framing.py encode_frame semantics: fall back to raw otherwise).
+std::string MakeFrame(const std::string& body, bool compress) {
+  std::string out_body = body;
+  uint8_t ct = 0;
+  if (compress) {
+    std::string buf(snappy_max_compressed_length(body.size()), '\0');
+    size_t clen = buf.size();
+    if (snappy_compress(body.data(), body.size(), buf.data(), &clen) == 0 &&
+        clen < body.size()) {
+      buf.resize(clen);
+      out_body = std::move(buf);
+      ct = 1;
+    }
+  }
+  std::string frame;
+  frame.reserve(5 + out_body.size());
+  frame.push_back('C');
+  frame.push_back('H');
+  frame.push_back(char((out_body.size() >> 8) & 0xFF));
+  frame.push_back(char(out_body.size() & 0xFF));
+  frame.push_back(char(ct));
+  frame += out_body;
+  return frame;
+}
+}  // namespace
 
 namespace chtpu_sdk {
 
@@ -187,27 +218,12 @@ bool ChanneldClient::Flush() {
         last_error_ = "message exceeds 64KB packet cap (dropped)";
         continue;
       }
-      std::string frame;
-      frame.reserve(kHeader + single_body.size());
-      frame.push_back('C');
-      frame.push_back('H');
-      frame.push_back(char((single_body.size() >> 8) & 0xFF));
-      frame.push_back(char(single_body.size() & 0xFF));
-      frame.push_back(0);  // no compression client->server
-      frame += single_body;
-      if (!WriteAll(frame)) return false;
+      if (!WriteAll(MakeFrame(single_body, peer_compression_ == 1)))
+        return false;
     }
     return true;
   }
-  std::string frame;
-  frame.reserve(kHeader + body.size());
-  frame.push_back('C');
-  frame.push_back('H');
-  frame.push_back(char((body.size() >> 8) & 0xFF));
-  frame.push_back(char(body.size() & 0xFF));
-  frame.push_back(0);
-  frame += body;
-  return WriteAll(frame);
+  return WriteAll(MakeFrame(body, peer_compression_ == 1));
 }
 
 bool ChanneldClient::WriteAll(const std::string& data) {
@@ -394,8 +410,13 @@ void ChanneldClient::InstallDefaultHandlers() {
   AddHandler(kAuth, [this](uint32_t, const std::string& body) {
     chtpu::AuthResultMessage msg;
     if (msg.ParseFromString(body) &&
-        msg.result() == chtpu::AuthResultMessage::SUCCESSFUL && conn_id_ == 0)
+        msg.result() == chtpu::AuthResultMessage::SUCCESSFUL &&
+        conn_id_ == 0) {
       conn_id_ = msg.connid();
+      // The gateway announces the compression it will use from now on;
+      // mirror it on the send path (ref: client.go handleAuth).
+      peer_compression_ = uint8_t(msg.compressiontype());
+    }
   });
   AddHandler(kCreateChannel, [this](uint32_t, const std::string& body) {
     chtpu::CreateChannelResultMessage msg;

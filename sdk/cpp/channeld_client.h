@@ -115,6 +115,8 @@ class ChanneldClient {
   int fd_ = -1;
   bool connected_ = false;
   uint32_t conn_id_ = 0;
+  // Compression announced by the gateway's AuthResult; mirrored on send.
+  uint8_t peer_compression_ = 0;
   uint32_t next_stub_ = 1;
   std::string last_error_;
   std::string rbuf_;

@@ -45,8 +45,10 @@ def _free_tcp_port() -> int:
     return port
 
 
-@pytest.mark.parametrize("transport", ["tcp", "kcp"])
-def test_cpp_sdk_chat_roundtrip(example_bin, tmp_path, transport):
+@pytest.mark.parametrize("transport,ct", [
+    ("tcp", "0"), ("kcp", "0"), ("tcp", "1")],
+    ids=["tcp", "kcp", "tcp-snappy"])
+def test_cpp_sdk_chat_roundtrip(example_bin, tmp_path, transport, ct):
     ca, sa = _free_tcp_port(), _free_tcp_port()
     # Gateway output goes to a file, not a pipe: an unread PIPE fills at
     # ~64KB of info-level logs and deadlocks the gateway mid-test.
@@ -55,7 +57,7 @@ def test_cpp_sdk_chat_roundtrip(example_bin, tmp_path, transport):
         [sys.executable, "-m", "channeld_tpu", "-dev", "-loglevel", "0",
          "-cn", transport, "-ca", f":{ca}", "-sn", "tcp", "-sa", f":{sa}",
          "-cwm", "false", "-cfsm", "config/client_authoritative_fsm.json",
-         "-mport", "0", "-imports", "channeld_tpu.compat"],
+         "-mport", "0", "-ct", ct, "-imports", "channeld_tpu.compat"],
         cwd=REPO, stdout=gw_log, stderr=subprocess.STDOUT, text=True,
     )
     try:
