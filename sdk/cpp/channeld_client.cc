@@ -119,6 +119,99 @@ bool ChanneldClient::Connect(const std::string& host, int port,
   return true;
 }
 
+namespace {
+std::string Base64(const uint8_t* data, size_t n) {
+  static const char tab[] =
+      "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+  std::string out;
+  for (size_t i = 0; i < n; i += 3) {
+    uint32_t v = uint32_t(data[i]) << 16;
+    if (i + 1 < n) v |= uint32_t(data[i + 1]) << 8;
+    if (i + 2 < n) v |= data[i + 2];
+    out.push_back(tab[(v >> 18) & 63]);
+    out.push_back(tab[(v >> 12) & 63]);
+    out.push_back(i + 1 < n ? tab[(v >> 6) & 63] : '=');
+    out.push_back(i + 2 < n ? tab[v & 63] : '=');
+  }
+  return out;
+}
+
+// One masked client frame (RFC6455 §5: client->server MUST mask).
+std::string WsFrame(uint8_t opcode, const std::string& payload,
+                    std::mt19937& rng) {
+  std::string f;
+  f.push_back(char(0x80 | opcode));  // FIN + opcode
+  size_t n = payload.size();
+  if (n < 126) {
+    f.push_back(char(0x80 | n));
+  } else if (n <= 0xFFFF) {
+    f.push_back(char(0x80 | 126));
+    f.push_back(char((n >> 8) & 0xFF));
+    f.push_back(char(n & 0xFF));
+  } else {
+    f.push_back(char(0x80 | 127));
+    for (int i = 7; i >= 0; i--) f.push_back(char((uint64_t(n) >> (8 * i)) & 0xFF));
+  }
+  uint8_t mask[4];
+  uint32_t m = rng();
+  memcpy(mask, &m, 4);
+  f.append(reinterpret_cast<char*>(mask), 4);
+  for (size_t i = 0; i < n; i++)
+    f.push_back(char(uint8_t(payload[i]) ^ mask[i & 3]));
+  return f;
+}
+}  // namespace
+
+bool ChanneldClient::ConnectWs(const std::string& host, int port,
+                               const std::string& path, double timeout_s) {
+  if (!Connect(host, port, timeout_s)) return false;
+  ws_raw_.clear();
+  ws_frag_.clear();
+  ws_frag_active_ = false;
+  auto fail_ws = [this](const std::string& why) {
+    last_error_ = why;
+    connected_ = false;
+    close(fd_);
+    fd_ = -1;
+    return false;
+  };
+  uint8_t key_bytes[16];
+  std::random_device rd;
+  for (auto& b : key_bytes) b = uint8_t(rd());
+  std::string key = Base64(key_bytes, sizeof(key_bytes));
+  std::string req =
+      "GET " + path + " HTTP/1.1\r\n"
+      "Host: " + host + ":" + std::to_string(port) + "\r\n"
+      "Upgrade: websocket\r\n"
+      "Connection: Upgrade\r\n"
+      "Sec-WebSocket-Key: " + key + "\r\n"
+      "Sec-WebSocket-Version: 13\r\n\r\n";
+  // ws_ is still false here, so WriteAll takes the raw TCP path.
+  if (!WriteAll(req)) return fail_ws("ws handshake send failed");
+  std::string resp;
+  double deadline = MonoNow() + timeout_s;
+  while (resp.find("\r\n\r\n") == std::string::npos) {
+    if (MonoNow() > deadline) return fail_ws("ws handshake timeout");
+    pollfd pfd{fd_, POLLIN, 0};
+    if (poll(&pfd, 1, 100) <= 0) continue;
+    char buf[4096];
+    ssize_t n = recv(fd_, buf, sizeof(buf), 0);
+    if (n <= 0) return fail_ws("ws handshake: peer closed");
+    resp.append(buf, size_t(n));
+  }
+  // Status LINE check, not a substring hunt over the whole response (a
+  // 400 page containing " 101" must not count as an upgrade).
+  size_t eol = resp.find("\r\n");
+  std::string status = resp.substr(0, eol);
+  if (status.rfind("HTTP/1.1 101", 0) != 0 &&
+      status.rfind("HTTP/1.0 101", 0) != 0)
+    return fail_ws("ws handshake rejected: " + status.substr(0, 120));
+  // Anything past the headers is already WS frame data.
+  ws_raw_ = resp.substr(resp.find("\r\n\r\n") + 4);
+  ws_ = true;
+  return true;
+}
+
 bool ChanneldClient::ConnectKcp(const std::string& host, int port,
                                 double timeout_s) {
   addrinfo hints{};
@@ -159,6 +252,10 @@ void ChanneldClient::Disconnect() {
   fd_ = -1;
   connected_ = false;
   kcp_.reset();  // a later Connect() must not revive the KCP path
+  ws_ = false;   // ...nor the WebSocket path
+  ws_raw_.clear();
+  ws_frag_.clear();
+  ws_frag_active_ = false;
 }
 
 void ChanneldClient::Auth(const std::string& pit,
@@ -227,6 +324,22 @@ bool ChanneldClient::Flush() {
 }
 
 bool ChanneldClient::WriteAll(const std::string& data) {
+  if (ws_) {
+    static thread_local std::mt19937 rng{std::random_device{}()};
+    std::string frame = WsFrame(0x2, data, rng);
+    size_t off = 0;
+    while (off < frame.size()) {
+      ssize_t n =
+          send(fd_, frame.data() + off, frame.size() - off, MSG_NOSIGNAL);
+      if (n <= 0) {
+        last_error_ = std::string("ws send failed: ") + strerror(errno);
+        connected_ = false;
+        return false;
+      }
+      off += size_t(n);
+    }
+    return true;
+  }
   if (kcp_) {
     // The framed byte stream rides the ARQ; datagrams go out via
     // conv.flush() (window-permitting) and retransmit on timers.
@@ -279,9 +392,99 @@ bool ChanneldClient::WaitFor(uint32_t msg_type, double timeout_s,
   return got;
 }
 
+// Parse complete WS frames out of ws_raw_ into rbuf_ (binary payloads),
+// answering pings and honoring close. Returns true if stream bytes
+// landed in rbuf_.
+bool ChanneldClient::DrainWsFrames() {
+  bool any = false;
+  size_t pos = 0;
+  while (ws_raw_.size() - pos >= 2) {
+    const uint8_t* p = reinterpret_cast<const uint8_t*>(ws_raw_.data()) + pos;
+    bool fin = p[0] & 0x80;
+    uint8_t opcode = p[0] & 0x0F;
+    bool masked = p[1] & 0x80;
+    uint64_t len = p[1] & 0x7F;
+    size_t hdr = 2;
+    if (len == 126) {
+      if (ws_raw_.size() - pos < 4) break;
+      len = (uint64_t(p[2]) << 8) | p[3];
+      hdr = 4;
+    } else if (len == 127) {
+      if (ws_raw_.size() - pos < 10) break;
+      len = 0;
+      for (int i = 0; i < 8; i++) len = (len << 8) | p[2 + i];
+      hdr = 10;
+    }
+    size_t mask_off = hdr;
+    if (masked) hdr += 4;
+    if (ws_raw_.size() - pos < hdr + len) break;
+    std::string payload(ws_raw_, pos + hdr, size_t(len));
+    if (masked)
+      for (size_t i = 0; i < payload.size(); i++)
+        payload[i] = char(uint8_t(payload[i]) ^ p[mask_off + (i & 3)]);
+    pos += hdr + size_t(len);
+    if (opcode == 0x2 || opcode == 0x0) {
+      if (!fin) {
+        ws_frag_active_ = true;
+        ws_frag_ += payload;
+      } else if (ws_frag_active_ && opcode == 0x0) {
+        rbuf_ += ws_frag_ + payload;
+        ws_frag_.clear();
+        ws_frag_active_ = false;
+        any = true;
+      } else {
+        rbuf_ += payload;
+        any = true;
+      }
+    } else if (opcode == 0x9) {  // ping -> pong with same payload
+      static thread_local std::mt19937 rng{std::random_device{}()};
+      std::string pong = WsFrame(0xA, payload, rng);
+      size_t off = 0;
+      while (off < pong.size()) {  // partial pong would desync the stream
+        ssize_t n =
+            send(fd_, pong.data() + off, pong.size() - off, MSG_NOSIGNAL);
+        if (n <= 0) {
+          last_error_ = std::string("ws pong send failed: ") + strerror(errno);
+          connected_ = false;
+          break;
+        }
+        off += size_t(n);
+      }
+    } else if (opcode == 0x8) {  // close
+      last_error_ = "ws closed by peer";
+      connected_ = false;
+    }
+    // 0x1 (text) / 0xA (pong): ignored — the gateway sends binary only.
+  }
+  ws_raw_.erase(0, pos);
+  return any;
+}
+
 bool ChanneldClient::ReadIntoBuffer(double timeout_s) {
   pollfd pfd{fd_, POLLIN, 0};
   int ms = int(timeout_s * 1000.0);
+  if (ws_) {
+    // Handshake leftovers may already hold complete frames.
+    bool any = DrainWsFrames();
+    int wait = any ? 0 : ms;
+    if (poll(&pfd, 1, wait) > 0) {
+      char buf[65536];
+      while (true) {
+        ssize_t n = recv(fd_, buf, sizeof(buf), MSG_DONTWAIT);
+        if (n > 0) {
+          ws_raw_.append(buf, size_t(n));
+          continue;
+        }
+        if (n == 0) {
+          last_error_ = "peer closed";
+          connected_ = false;
+        }
+        break;
+      }
+      any = DrainWsFrames() || any;
+    }
+    return any;
+  }
   if (kcp_) {
     // Cap the wait at the nearest retransmit deadline: on a silent
     // link poll() would otherwise stall RTO-due retransmits for the

@@ -69,6 +69,10 @@ class ChanneldClient {
   // KCP dial (UDP; the reference's -cn kcp listener). Same API surface —
   // the framed byte stream rides the KCP ARQ (sdk/cpp/kcp_conv.h).
   bool ConnectKcp(const std::string& host, int port, double timeout_s = 5.0);
+  // WebSocket dial (the reference's -cn ws listener): RFC6455 client
+  // handshake, then each framed packet rides one masked binary message.
+  bool ConnectWs(const std::string& host, int port,
+                 const std::string& path = "/", double timeout_s = 5.0);
   void Disconnect();  // sends DISCONNECT, closes the socket
   bool connected() const { return connected_; }
   uint32_t id() const { return conn_id_; }
@@ -108,10 +112,15 @@ class ChanneldClient {
   bool ReadIntoBuffer(double timeout_s);
   void DecodeAndDispatch();
   bool WriteAll(const std::string& data);
+  bool DrainWsFrames();
   void InstallDefaultHandlers();
 
   struct KcpState;  // defined in the .cc (keeps kcp_conv.h out of users)
   std::unique_ptr<KcpState> kcp_;
+  bool ws_ = false;        // WebSocket mode after a successful handshake
+  std::string ws_raw_;     // raw TCP bytes pending WS frame parse
+  std::string ws_frag_;    // continuation-fragment reassembly
+  bool ws_frag_active_ = false;
   int fd_ = -1;
   bool connected_ = false;
   uint32_t conn_id_ = 0;

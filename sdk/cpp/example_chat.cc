@@ -23,9 +23,10 @@ int main(int argc, char** argv) {
   const char* transport = argc > 3 ? argv[3] : "tcp";
 
   ChanneldClient client;
-  bool ok = std::string(transport) == "kcp"
-                ? client.ConnectKcp(host, port)
-                : client.Connect(host, port);
+  std::string t = transport;
+  bool ok = t == "kcp"  ? client.ConnectKcp(host, port)
+            : t == "ws" ? client.ConnectWs(host, port)
+                        : client.Connect(host, port);
   if (!ok) return fail(client, "connect");
 
   client.Auth("cpp-sdk-smoke", "token");

@@ -46,8 +46,8 @@ def _free_tcp_port() -> int:
 
 
 @pytest.mark.parametrize("transport,ct", [
-    ("tcp", "0"), ("kcp", "0"), ("tcp", "1")],
-    ids=["tcp", "kcp", "tcp-snappy"])
+    ("tcp", "0"), ("kcp", "0"), ("ws", "0"), ("tcp", "1")],
+    ids=["tcp", "kcp", "ws", "tcp-snappy"])
 def test_cpp_sdk_chat_roundtrip(example_bin, tmp_path, transport, ct):
     ca, sa = _free_tcp_port(), _free_tcp_port()
     # Gateway output goes to a file, not a pipe: an unread PIPE fills at
@@ -64,7 +64,7 @@ def test_cpp_sdk_chat_roundtrip(example_bin, tmp_path, transport, ct):
         # TCP probes the client listener directly; for kcp (UDP client
         # listener) probe the TCP SERVER listener — the KCP client's ARQ
         # retransmits the handshake until the UDP port appears.
-        probe = ca if transport == "tcp" else sa
+        probe = sa if transport == "kcp" else ca  # kcp's ca is UDP
         deadline = time.monotonic() + 30
         while time.monotonic() < deadline:
             try:
