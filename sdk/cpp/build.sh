@@ -25,3 +25,6 @@ g++ $CXXFLAGS example_chat.cc libchanneld_client.a gen/chatpb.pb.o \
     -lprotobuf -l:libsnappy.so.1 -L/usr/lib/x86_64-linux-gnu \
     -o example_chat
 echo "built: sdk/cpp/libchanneld_client.a, sdk/cpp/example_chat"
+g++ $CXXFLAGS load_client.cc gen/wire.pb.o gen/control.pb.o \
+    -lprotobuf -o load_client
+echo "built: sdk/cpp/load_client"

@@ -1,0 +1,261 @@
+// Native gateway load driver: N client connections in one epoll loop.
+//
+// The Python load driver (scripts/load_driver.py) is honest but
+// GIL-bound — at high offered rates the measurement is driver-limited
+// (BENCH_RESULTS round-2 notes). This C++ driver removes that ceiling:
+// precomputed steady-state frames, inbound counted by 5-byte tag scan
+// (no proto parse per message), single thread, epoll.
+//
+// Flow per connection mirrors the Python driver: connect -> AUTH ->
+// wait for the auth-result frame -> SUB to GLOBAL with write access ->
+// steady-state sends at the configured per-connection rate.
+//
+//   load_client <host> <port> <conns> <rate_per_conn> <duration_s>
+//               [connect_stagger_us]
+//
+// Prints one JSON line: conns, authed, sent, frames_in, elapsed.
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstdio>
+#include <cstring>
+#include <algorithm>
+#include <string>
+#include <vector>
+
+#include "channeld_tpu/protocol/control.pb.h"
+#include "channeld_tpu/protocol/wire.pb.h"
+
+namespace {
+
+double MonoNow() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec + ts.tv_nsec * 1e-9;
+}
+
+std::string Frame(uint32_t msg_type, const std::string& body,
+                  uint32_t channel_id = 0) {
+  chtpu::Packet p;
+  auto* pack = p.add_messages();
+  pack->set_channelid(channel_id);
+  pack->set_msgtype(msg_type);
+  pack->set_msgbody(body);
+  std::string b = p.SerializeAsString();
+  std::string f;
+  f.reserve(5 + b.size());
+  f.push_back('C');
+  f.push_back('H');
+  f.push_back(char((b.size() >> 8) & 0xFF));
+  f.push_back(char(b.size() & 0xFF));
+  f.push_back(0);
+  f += b;
+  return f;
+}
+
+struct Conn {
+  int fd = -1;
+  bool authed = false;
+  bool closed = false;
+  std::string rbuf;
+  std::string obuf;  // unsent tail after a partial write (frame-atomic)
+  long frames_in = 0;
+  double next_send = 0;
+
+  // Consume complete frames; count them. Partial tail stays buffered.
+  void CountFrames() {
+    size_t pos = 0;
+    while (rbuf.size() - pos >= 5) {
+      size_t size = (size_t(uint8_t(rbuf[pos + 2])) << 8) |
+                    uint8_t(rbuf[pos + 3]);
+      if (rbuf.size() - pos < 5 + size) break;
+      pos += 5 + size;
+      frames_in++;
+    }
+    rbuf.erase(0, pos);
+  }
+
+  // Frame-atomic non-blocking send; stashes the unsent TAIL.
+  bool TrySend(const std::string& frame) {
+    if (closed) return false;
+    if (!obuf.empty()) {
+      ssize_t n = send(fd, obuf.data(), obuf.size(), MSG_NOSIGNAL);
+      if (n > 0) obuf.erase(0, size_t(n));
+      else if (n < 0 && errno != EAGAIN && errno != EWOULDBLOCK) {
+        closed = true;
+        return false;
+      }
+      if (!obuf.empty()) {
+        obuf += frame;  // keep wire order
+        return true;
+      }
+    }
+    ssize_t n = send(fd, frame.data(), frame.size(), MSG_NOSIGNAL);
+    if (n < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) n = 0;
+      else {
+        closed = true;
+        return false;
+      }
+    }
+    if (size_t(n) < frame.size()) obuf = frame.substr(size_t(n));
+    return true;
+  }
+};
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 6) {
+    fprintf(stderr,
+            "usage: load_client <host> <port> <conns> <rate_per_conn> "
+            "<duration_s> [connect_stagger_us]\n");
+    return 64;
+  }
+  const char* host = argv[1];
+  int port = atoi(argv[2]);
+  int n_conns = atoi(argv[3]);
+  double rate = atof(argv[4]);
+  double duration = atof(argv[5]);
+  long stagger_us = argc > 6 ? atol(argv[6]) : 0;
+
+  addrinfo hints{}, *res = nullptr;
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  if (getaddrinfo(host, argv[2], &hints, &res) != 0 || !res) {
+    fprintf(stderr, "resolve failed\n");
+    return 1;
+  }
+
+  std::string sub = Frame(
+      6, [] {  // SUB_TO_CHANNEL, write access, damped fan-out
+        chtpu::SubscribedToChannelMessage m;
+        m.mutable_suboptions()->set_dataaccess(chtpu::WRITE_ACCESS);
+        m.mutable_suboptions()->set_fanoutintervalms(2000);
+        return m.SerializeAsString();
+      }());
+  // Steady state: opaque user-space forward (msgType 100) — the
+  // reference's headline routing scenario (bodies unparsed).
+  std::string update = Frame(100, "\x08\x01\x12\x10pppppppppppppppp");
+
+  int ep = epoll_create1(0);
+  std::vector<Conn> conns(n_conns);
+  int connect_errors = 0;
+
+  for (int i = 0; i < n_conns; i++) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    if (connect(fd, res->ai_addr, res->ai_addrlen) != 0) {
+      close(fd);
+      connect_errors++;
+      conns[i].closed = true;
+      continue;
+    }
+    chtpu::AuthMessage auth;
+    auth.set_playeridentifiertoken("load-cpp-" + std::to_string(i));
+    auth.set_logintoken("load");
+    std::string auth_frame = Frame(1, auth.SerializeAsString());
+    if (send(fd, auth_frame.data(), auth_frame.size(), MSG_NOSIGNAL) < 0) {
+      close(fd);
+      connect_errors++;
+      conns[i].closed = true;
+      continue;
+    }
+    // Non-blocking from here on (sends must never stall the loop).
+    fcntl(fd, F_SETFL, fcntl(fd, F_GETFL, 0) | O_NONBLOCK);
+    conns[i].fd = fd;
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.u32 = uint32_t(i);
+    epoll_ctl(ep, EPOLL_CTL_ADD, fd, &ev);
+    if (stagger_us) usleep(useconds_t(stagger_us));
+  }
+  freeaddrinfo(res);
+
+  // Phase 2: collect auth results, then subscribe.
+  int authed = 0, live = 0;
+  for (auto& c : conns)
+    if (!c.closed) live++;
+  double deadline = MonoNow() + 90;
+  epoll_event events[1024];
+  char buf[262144];
+  while (authed < live && MonoNow() < deadline) {
+    int nev = epoll_wait(ep, events, 1024, 200);
+    for (int e = 0; e < nev; e++) {
+      Conn& c = conns[events[e].data.u32];
+      ssize_t n = recv(c.fd, buf, sizeof(buf), MSG_DONTWAIT);
+      if (n <= 0) {
+        if (n == 0) {
+          c.closed = true;
+          live--;
+        }
+        continue;
+      }
+      c.rbuf.append(buf, size_t(n));
+      long before = c.frames_in;
+      c.CountFrames();
+      if (c.frames_in > before && !c.authed) {
+        c.authed = true;
+        authed++;
+        c.TrySend(sub);
+      }
+    }
+  }
+
+  // Phase 3: steady state.
+  long sent = 0;
+  double t0 = MonoNow();
+  double t_end = t0 + duration;
+  double interval = rate > 0 ? 1.0 / rate : duration;
+  {
+    int i = 0;
+    for (auto& c : conns)
+      c.next_send = t0 + interval * (double(i++) / std::max(live, 1));
+  }
+  while (true) {
+    double now = MonoNow();
+    if (now >= t_end) break;
+    bool idle = true;
+    for (auto& c : conns) {
+      if (c.closed || !c.authed) continue;
+      if (now >= c.next_send) {
+        idle = false;
+        if (c.TrySend(update)) sent++;
+        c.next_send += interval;
+        if (c.next_send < now - 1.0) c.next_send = now + interval;
+      }
+    }
+    int nev = epoll_wait(ep, events, 1024, idle ? 2 : 0);
+    for (int e = 0; e < nev; e++) {
+      Conn& c = conns[events[e].data.u32];
+      ssize_t n = recv(c.fd, buf, sizeof(buf), MSG_DONTWAIT);
+      if (n <= 0) {
+        if (n == 0) c.closed = true;
+        continue;
+      }
+      c.rbuf.append(buf, size_t(n));
+      c.CountFrames();
+    }
+  }
+  double elapsed = MonoNow() - t0;
+
+  long frames_in = 0;
+  for (auto& c : conns) {
+    frames_in += c.frames_in;
+    if (c.fd >= 0) close(c.fd);
+  }
+  printf(
+      "{\"driver\": \"cpp\", \"conns\": %d, \"authed\": %d, "
+      "\"connect_errors\": %d, \"sent\": %ld, \"frames_in\": %ld, "
+      "\"elapsed\": %.2f, \"sent_mps\": %.0f, \"recv_fps\": %.0f}\n",
+      n_conns, authed, connect_errors, sent, frames_in, elapsed,
+      sent / elapsed, frames_in / elapsed);
+  return 0;
+}
