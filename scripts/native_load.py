@@ -1,0 +1,91 @@
+"""Gateway load measurement with the NATIVE driver (sdk/cpp/load_client).
+
+Wraps the C++ epoll driver with the pieces it shouldn't own: the
+GLOBAL-owner drain connection (forward-mode traffic routes to the owner;
+reusing scripts/load_driver.py's implementation) and gateway /metrics
+deltas. One JSON line out.
+
+Run (gateway first — see load_driver.py's docstring):
+  python scripts/native_load.py --addr 127.0.0.1:12108 \
+      --server-addr 127.0.0.1:11288 --conns 1000 --rate 100 --duration 30
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from load_driver import fetch_metrics, owner_drain  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BIN = os.path.join(REPO, "sdk", "cpp", "load_client")
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description="native-driver gateway load")
+    p.add_argument("--addr", default="127.0.0.1:12108")
+    p.add_argument("--server-addr", default="127.0.0.1:11288")
+    p.add_argument("--conns", type=int, default=1000)
+    p.add_argument("--rate", type=float, default=100.0)
+    p.add_argument("--duration", type=float, default=30.0)
+    p.add_argument("--connect-stagger-us", type=int, default=200)
+    p.add_argument("--metrics-port", type=int, default=8080)
+    args = p.parse_args()
+
+    if not os.path.exists(BIN):
+        print(json.dumps({"error": f"{BIN} missing; run sh sdk/cpp/build.sh"}))
+        raise SystemExit(1)
+
+    stop = threading.Event()
+    counters: dict = {}
+    owner = threading.Thread(
+        target=owner_drain, args=(args.server_addr, stop, counters),
+        daemon=True,
+    )
+    owner.start()
+    time.sleep(1.0)  # owner possesses GLOBAL first
+
+    host, _, port = args.addr.rpartition(":")
+    before = fetch_metrics(args.metrics_port)
+    proc = subprocess.run(
+        [BIN, host or "127.0.0.1", port, str(args.conns), str(args.rate),
+         str(args.duration), str(args.connect_stagger_us)],
+        capture_output=True, text=True,
+        timeout=args.duration + args.conns * args.connect_stagger_us / 1e6
+        + 150,
+    )
+    after = fetch_metrics(args.metrics_port)
+    stop.set()
+    owner.join(timeout=3)
+
+    driver = json.loads(proc.stdout.strip().splitlines()[-1]) \
+        if proc.returncode == 0 and proc.stdout.strip() else \
+        {"error": f"rc={proc.returncode}: {proc.stderr[-200:]}"}
+    delta = {k: after.get(k, 0.0) - before.get(k, 0.0)
+             for k in after if "bucket" not in k and "connection_num" not in k}
+    gw_in = sum(v for k, v in delta.items()
+                if k.startswith("messages_in_total"))
+    gw_out = sum(v for k, v in delta.items()
+                 if k.startswith("messages_out_total"))
+    elapsed = driver.get("elapsed", args.duration)
+    print(json.dumps({
+        "metric": "native_driver_load",
+        "offered_mps": round(args.conns * args.rate),
+        "driver": driver,
+        "owner_frames_in": counters.get("owner_frames_in", 0),
+        "owner_error": counters.get("owner_error", ""),
+        "gateway_in_mps": round(gw_in / elapsed) if elapsed else 0,
+        "gateway_out_mps": round(gw_out / elapsed) if elapsed else 0,
+        "gateway_metrics_delta": {k: round(v) for k, v in sorted(delta.items())},
+    }))
+
+
+if __name__ == "__main__":
+    main()
