@@ -25,6 +25,23 @@ if os.environ.get("CHTPU_TEST_TPU") != "1":
 
 import pytest  # noqa: E402
 
+# Build the native codec once if a toolchain exists, so the native-path
+# parity tests run instead of skipping (they skip gracefully if this
+# fails — e.g. no g++). Cheap (~5s) and idempotent.
+_repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_codec_src = os.path.join(_repo, "channeld_tpu", "native", "codec.cc")
+_codec_glob = os.path.join(_repo, "channeld_tpu", "native")
+if not any(
+    f.startswith("_codec") and f.endswith(".so")
+    for f in os.listdir(_codec_glob)
+):
+    import subprocess
+
+    subprocess.run(
+        ["sh", os.path.join(_repo, "scripts", "build_native.sh")],
+        cwd=_repo, capture_output=True, timeout=120, check=False,
+    )
+
 
 @pytest.fixture(autouse=True)
 def _fresh_globals():
