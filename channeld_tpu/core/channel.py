@@ -100,7 +100,9 @@ class Channel:
         self.latest_data_update_conn_id = 0
         self.spatial_notifier = None
         self.entity_controller = None
-        self.in_msg_queue: asyncio.Queue = asyncio.Queue(maxsize=QUEUE_CAPACITY)
+        # Unbounded asyncio.Queue; the external-put bound (QUEUE_CAPACITY)
+        # is enforced in _enqueue so internal puts keep a reserve.
+        self.in_msg_queue: asyncio.Queue = asyncio.Queue()
         self.fan_out_queue: list[FanOutConnection] = []
         # Spatial channels with a TPU controller: engine sub-table slot ->
         # FanOutConnection, for consuming the batched device due mask;
@@ -182,12 +184,16 @@ class Channel:
 
     # ---- message queue ---------------------------------------------------
 
-    def put_message(self, msg, handler, conn, pack, raw_body=None) -> None:
+    def put_message(self, msg, handler, conn, pack, raw_body=None,
+                    external: bool = False) -> bool:
         """Enqueue from any task; handled in this channel's tick
         (ref: channel.go:295-310). ``raw_body`` carries the inbound bytes
-        through for pure forwards so the send side need not re-encode."""
+        through for pure forwards so the send side need not re-encode.
+        False = queue full: NOT enqueued, NOT dropped — the caller must
+        stash and retry after backpressure drains (connection.on_bytes
+        does)."""
         if self.is_removing():
-            return
+            return True  # channel dying: message vanishes, like the ref
         from .message import MessageContext
 
         ctx = MessageContext(
@@ -201,7 +207,7 @@ class Channel:
             arrival_time=self.get_time(),
             raw_body=raw_body,
         )
-        self._enqueue(_QueuedMessage(ctx, handler))
+        return self._enqueue(_QueuedMessage(ctx, handler), external=external)
 
     def put_message_context(self, ctx, handler) -> None:
         if self.is_removing():
@@ -233,25 +239,35 @@ class Channel:
         to touch channel state from outside (ref: channel.go:346-352)."""
         self._enqueue(_QueuedMessage(None, lambda _ctx: callback(self)))
 
-    def _enqueue(self, qm: _QueuedMessage) -> None:
-        try:
-            self.in_msg_queue.put_nowait(qm)
-        except asyncio.QueueFull:
-            # Watermark backpressure should make this unreachable; dropping
-            # is the last resort (the reference would block forever).
-            self.logger.warning("in-queue full, dropping message")
-            return
+    def _enqueue(self, qm: _QueuedMessage, external: bool = False) -> bool:
+        """Enqueue for this channel's tick. External (connection-fed) puts
+        are bounded at QUEUE_CAPACITY: a full queue returns False WITHOUT
+        dropping — the connection stashes the message and its reads pause
+        until the queue drains (the asyncio analog of the reference's
+        blocking `inMsgQueue <-` send, channel.go:295-310; nothing is
+        lost). Internal puts (execute callbacks, owner-side messages) ride
+        a reserve above the cap: they are control-plane, self-limited, and
+        dropping them would corrupt channel state."""
+        size = self.in_msg_queue.qsize()
+        if external and size >= QUEUE_CAPACITY:
+            self._mark_congested(qm)
+            return False
+        self.in_msg_queue.put_nowait(qm)
         self._wake.set()
-        if self.in_msg_queue.qsize() >= _HIGH_WATERMARK:
-            _congested_channels.add(self.id)
-            # Remember which connection fed the congested queue so only its
-            # reads pause (None for internal puts).
-            conn = getattr(qm.ctx, "connection", None) if qm.ctx else None
-            if conn is not None:
-                pending = getattr(conn, "backpressure_channels", None)
-                if pending is None:
-                    pending = conn.backpressure_channels = set()
-                pending.add(self.id)
+        if size + 1 >= _HIGH_WATERMARK:
+            self._mark_congested(qm)
+        return True
+
+    def _mark_congested(self, qm: _QueuedMessage) -> None:
+        _congested_channels.add(self.id)
+        # Remember which connection fed the congested queue so only its
+        # reads pause (None for internal puts).
+        conn = getattr(qm.ctx, "connection", None) if qm.ctx else None
+        if conn is not None:
+            pending = getattr(conn, "backpressure_channels", None)
+            if pending is None:
+                pending = conn.backpressure_channels = set()
+            pending.add(self.id)
 
     # ---- tick ------------------------------------------------------------
 

@@ -12,6 +12,7 @@ seam so tests can use in-process pipes, mirroring the reference's
 from __future__ import annotations
 
 import time
+from collections import deque
 from typing import Callable, Optional, Protocol
 
 from ..protocol import FramingError, MESSAGE_TEMPLATES, encode_frame, wire_pb2
@@ -112,6 +113,10 @@ class Connection:
         self.compression_type = CompressionType.NO_COMPRESSION
         self.transport = transport
         self.decoder = FrameDecoder()
+        # Messages that hit a full channel queue, head-first; reads stay
+        # paused until flush_pending() re-dispatches them (lossless
+        # backpressure; bounded by one read's worth of messages).
+        self._pending_msgs: deque = deque()
         self.sender: MessageSender = QueuedMessagePackSender()
         # (channelId, broadcast, stubId, msgType, body) tuples.
         self.send_queue: list[tuple] = []
@@ -173,17 +178,46 @@ class Connection:
             if self._is_packet_recording_enabled() and self.replay_session is not None:
                 self.replay_session.record(packet)
             dropped_any = False
-            for mp in packet.messages:
-                if not self.receive_message(mp):
+            for i, mp in enumerate(packet.messages):
+                if self._pending_msgs:
+                    # Order must hold: once anything is stashed, every
+                    # later message queues behind it.
+                    self._pending_msgs.extend(packet.messages[i:])
+                    break
+                result = self.receive_message(mp)
+                if result is None:  # target queue full: stash, not drop
+                    self._pending_msgs.extend(packet.messages[i:])
+                    break
+                if not result:
                     dropped_any = True
             if dropped_any:
                 # Counted once per packet (the reference's packet-level
                 # dropped counter), whatever the drop reason.
                 self._m_packet_dropped.inc()
 
-    def receive_message(self, mp: wire_pb2.MessagePack) -> bool:
-        """Dispatch one message pack to its channel queue; False when the
-        message was dropped (ref: connection.go:547-615)."""
+    def has_pending(self) -> bool:
+        return bool(self._pending_msgs)
+
+    def flush_pending(self) -> bool:
+        """Re-dispatch stashed messages in order; True when drained.
+        Stops (False) at the first message whose channel queue is still
+        full — call again after the next drain signal."""
+        while self._pending_msgs:
+            result = self.receive_message(self._pending_msgs[0])
+            if result is None:
+                return False
+            self._pending_msgs.popleft()
+            if result is False:
+                self._m_packet_dropped.inc()
+        return True
+
+    def receive_message(self, mp: wire_pb2.MessagePack):
+        """Dispatch one message pack to its channel queue. True = enqueued
+        (or consumed), False = dropped (bad message / FSM / no channel),
+        None = target queue full — NOT processed; the caller must stash
+        the pack and retry once backpressure drains
+        (ref: connection.go:547-615; the reference's blocking queue send
+        maps to the stash + paused reads)."""
         from .channel import get_channel
         from .message import (
             MESSAGE_MAP,
@@ -258,7 +292,9 @@ class Connection:
         if self.fsm is not None:
             self.fsm.on_received(mp.msgType)
 
-        channel.put_message(msg, handler, self, mp, raw_body=raw_body)
+        if not channel.put_message(msg, handler, self, mp, raw_body=raw_body,
+                                   external=True):
+            return None  # queue full: caller stashes and retries (no drop)
         key = (channel.channel_type, mp.msgType)
         child = self._m_msg_received.get(key)
         if child is None:

@@ -9,6 +9,7 @@ channel lifecycle, sub/unsub, data update, disconnect.
 from __future__ import annotations
 
 import asyncio
+import time
 from dataclasses import dataclass, field
 from typing import TYPE_CHECKING, Callable, Optional
 
@@ -124,7 +125,17 @@ def handle_client_to_server_user_message(ctx: MessageContext) -> None:
             )
     else:
         if not ctx.channel.recoverable_subs:
-            ctx.channel.logger.warning("channel has no owner to forward to")
+            # Once per second per channel: every in-flight client message
+            # hits this line the moment an owner drops, and per-message
+            # warnings at load-test rates turn the log into the
+            # bottleneck (observed: >1M lines in 30s).
+            now = time.monotonic()
+            if now - getattr(ctx.channel, "_ownerless_warn_at", 0.0) > 1.0:
+                ctx.channel._ownerless_warn_at = now
+                ctx.channel.logger.warning(
+                    "channel has no owner to forward to (suppressing "
+                    "repeats for 1s)"
+                )
 
 
 def handle_server_to_client_user_message(ctx: MessageContext) -> None:

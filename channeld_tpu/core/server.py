@@ -172,8 +172,12 @@ async def start_listening(conn_type: ConnectionType, network: str, addr: str):
                     conn.on_bytes(message)
                     if conn.is_closing():
                         break
-                    if connection_congested(conn):
+                    while not conn.is_closing() and (
+                        conn.has_pending() or connection_congested(conn)
+                    ):
                         await congestion_wait(conn)
+                        if conn.has_pending() and not conn.flush_pending():
+                            await asyncio.sleep(0)
             except websockets.ConnectionClosed:
                 pass
             finally:
@@ -210,18 +214,30 @@ async def start_listening(conn_type: ConnectionType, network: str, addr: str):
 
             def on_stream(seg: bytes) -> None:
                 # ARQ backpressure: while this connection's channels are
-                # congested, drop the segment *before* it is acked — the
+                # congested (or messages are stashed behind a full
+                # queue), drop the segment *before* it is acked — the
                 # peer retransmits, so nothing is lost and its send window
                 # stalls, the reliable-UDP analog of pausing a TCP read.
-                if connection_congested(conn):
+                if conn.has_pending() or connection_congested(conn):
                     session.drop_unacked()
                     return
                 conn.on_bytes(seg)
+                if conn.has_pending():
+                    asyncio.ensure_future(_drain_rudp_stash(conn))
 
             session.on_stream = on_stream
             # FIN / peer loss must close the gateway connection like the
             # TCP/WS reactors do (recovery depends on this close event).
             session.on_close = lambda: conn.close(unexpected=True)
+
+        async def _drain_rudp_stash(conn) -> None:
+            from .channel import congestion_wait
+
+            while not conn.is_closing():
+                await congestion_wait(conn)
+                if conn.flush_pending():
+                    break
+                await asyncio.sleep(0)
 
         loop = asyncio.get_running_loop()
         transport, protocol = await loop.create_datagram_endpoint(
@@ -256,10 +272,11 @@ async def start_listening(conn_type: ConnectionType, network: str, addr: str):
 
             def on_stream(seg: bytes) -> None:
                 conn.on_bytes(seg)
-                if connection_congested(conn):
+                if conn.has_pending() or connection_congested(conn):
                     # KCP-native backpressure: pause delivery; the
                     # advertised receive window shrinks and the peer
-                    # stalls. Resume once the congested channel drains.
+                    # stalls. Resume once the congested channel drains
+                    # and any stashed messages re-dispatched (lossless).
                     session.pause()
                     asyncio.ensure_future(_resume_when_clear(conn, session))
 
@@ -269,7 +286,11 @@ async def start_listening(conn_type: ConnectionType, network: str, addr: str):
             session.on_close = lambda: conn.close(unexpected=True)
 
         async def _resume_when_clear(conn, session) -> None:
-            await congestion_wait(conn)
+            while not conn.is_closing():
+                await congestion_wait(conn)
+                if conn.flush_pending():
+                    break
+                await asyncio.sleep(0)  # still full; wait for next drain
             if not session.closed:
                 session.resume()
 
@@ -292,12 +313,17 @@ async def _reactor(conn: Connection, reader: asyncio.StreamReader) -> None:
             if not data:
                 break
             conn.on_bytes(data)
-            if connection_congested(conn):
-                # A channel this connection fed is above its high
-                # watermark: stop reading from *this* socket until it
-                # drains — TCP backpressure, like the reference's blocking
-                # queue send (channel.go:295-310).
+            # A channel this connection fed is congested (or outright
+            # full: messages are stashed, never dropped): stop reading
+            # from *this* socket until it drains, then re-dispatch the
+            # stash — TCP backpressure, like the reference's blocking
+            # queue send (channel.go:295-310).
+            while not conn.is_closing() and (
+                conn.has_pending() or connection_congested(conn)
+            ):
                 await congestion_wait(conn)
+                if conn.has_pending() and not conn.flush_pending():
+                    await asyncio.sleep(0)  # still full; wait again
     except (ConnectionResetError, asyncio.IncompleteReadError, OSError):
         pass
     finally:

@@ -36,6 +36,7 @@ def main() -> None:
     p.add_argument("--rate", type=float, default=100.0)
     p.add_argument("--duration", type=float, default=30.0)
     p.add_argument("--connect-stagger-us", type=int, default=200)
+    p.add_argument("--niceness", type=int, default=5)
     p.add_argument("--metrics-port", type=int, default=8080)
     args = p.parse_args()
 
@@ -56,7 +57,8 @@ def main() -> None:
     before = fetch_metrics(args.metrics_port)
     proc = subprocess.run(
         [BIN, host or "127.0.0.1", port, str(args.conns), str(args.rate),
-         str(args.duration), str(args.connect_stagger_us)],
+         str(args.duration), str(args.connect_stagger_us),
+         str(args.niceness)],
         capture_output=True, text=True,
         timeout=args.duration + args.conns * args.connect_stagger_us / 1e6
         + 150,

@@ -316,3 +316,49 @@ def test_banned_ip_refused_at_accept():
     with pytest.raises(ConnectionRefusedError):
         add_connection(t, ConnectionType.CLIENT)
     assert t.closed
+
+
+def test_full_queue_stashes_instead_of_dropping():
+    """A full channel in-queue must apply lossless backpressure: the
+    overflowing message is stashed on the connection (receive_message ->
+    None), reads pause via the congestion set, and flush_pending
+    re-dispatches everything in order once the tick drains the queue —
+    the asyncio analog of the reference's blocking inMsgQueue send
+    (channel.go:295-310). Before this contract, a 40K mps overload
+    dropped >1M messages (BENCH_RESULTS round-3)."""
+    from channeld_tpu.core import channel as channel_mod
+    from channeld_tpu.core.channel import get_global_channel
+
+    transport = FakeTransport()
+    conn = connection_mod.add_connection(transport, ConnectionType.CLIENT)
+    conn.on_bytes(wire(MessageType.AUTH, control_pb2.AuthMessage(
+        playerIdentifierToken="pit-bp", loginToken="lt")))
+    gch = get_global_channel()
+    gch.tick_once()
+
+    # Fill the queue to the external cap with user-space forwards.
+    frame = wire(100, control_pb2.AuthMessage())  # opaque body
+    baseline = gch.in_msg_queue.qsize()
+    for _ in range(channel_mod.QUEUE_CAPACITY - baseline):
+        conn.on_bytes(frame)
+    assert not conn.has_pending()
+    assert gch.in_msg_queue.qsize() == channel_mod.QUEUE_CAPACITY
+
+    # The next messages stash, never drop, and the conn reads congested.
+    for _ in range(3):
+        conn.on_bytes(frame)
+    assert conn.has_pending()
+    assert len(conn._pending_msgs) == 3
+    assert channel_mod.connection_congested(conn)
+    assert gch.in_msg_queue.qsize() == channel_mod.QUEUE_CAPACITY
+
+    # Internal control puts still fit (the reserve above the cap).
+    gch.execute(lambda ch: None)
+    assert gch.in_msg_queue.qsize() == channel_mod.QUEUE_CAPACITY + 1
+
+    # Drain the tick; flush_pending re-dispatches the stash in order.
+    gch.tick_once()
+    assert gch.in_msg_queue.qsize() == 0
+    assert conn.flush_pending()
+    assert not conn.has_pending()
+    assert gch.in_msg_queue.qsize() == 3
