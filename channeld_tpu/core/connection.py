@@ -177,23 +177,29 @@ class Connection:
             self._m_packet_received.inc()
             if self._is_packet_recording_enabled() and self.replay_session is not None:
                 self.replay_session.record(packet)
-            dropped_any = False
+            # One token per packet: packet_dropped increments at most once
+            # per originating packet, whether the drop happens here or
+            # later when a stashed tail flushes.
+            drop_token = [False]
             for i, mp in enumerate(packet.messages):
                 if self._pending_msgs:
                     # Order must hold: once anything is stashed, every
                     # later message queues behind it.
-                    self._pending_msgs.extend(packet.messages[i:])
+                    self._pending_msgs.extend(
+                        (m, drop_token) for m in packet.messages[i:]
+                    )
                     break
                 result = self.receive_message(mp)
                 if result is None:  # target queue full: stash, not drop
-                    self._pending_msgs.extend(packet.messages[i:])
+                    self._pending_msgs.extend(
+                        (m, drop_token) for m in packet.messages[i:]
+                    )
                     break
-                if not result:
-                    dropped_any = True
-            if dropped_any:
-                # Counted once per packet (the reference's packet-level
-                # dropped counter), whatever the drop reason.
-                self._m_packet_dropped.inc()
+                if not result and not drop_token[0]:
+                    # Counted once per packet (the reference's packet-level
+                    # dropped counter), whatever the drop reason.
+                    drop_token[0] = True
+                    self._m_packet_dropped.inc()
 
     def has_pending(self) -> bool:
         return bool(self._pending_msgs)
@@ -203,11 +209,13 @@ class Connection:
         Stops (False) at the first message whose channel queue is still
         full — call again after the next drain signal."""
         while self._pending_msgs:
-            result = self.receive_message(self._pending_msgs[0])
+            mp, drop_token = self._pending_msgs[0]
+            result = self.receive_message(mp)
             if result is None:
                 return False
             self._pending_msgs.popleft()
-            if result is False:
+            if result is False and not drop_token[0]:
+                drop_token[0] = True
                 self._m_packet_dropped.inc()
         return True
 
@@ -289,12 +297,15 @@ class Connection:
                 return False
             handler = entry.handler
 
-        if self.fsm is not None:
-            self.fsm.on_received(mp.msgType)
-
         if not channel.put_message(msg, handler, self, mp, raw_body=raw_body,
                                    external=True):
             return None  # queue full: caller stashes and retries (no drop)
+        # FSM advance only after the enqueue succeeds: the queue-full
+        # retry path re-enters this function with the same pack, and a
+        # transition applied on the failed attempt would either fire
+        # twice or make the retry disallowed by its own first attempt.
+        if self.fsm is not None:
+            self.fsm.on_received(mp.msgType)
         key = (channel.channel_type, mp.msgType)
         child = self._m_msg_received.get(key)
         if child is None:

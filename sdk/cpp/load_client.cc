@@ -70,16 +70,40 @@ struct Conn {
   double next_send = 0;
 
   // Consume complete frames; count them. Partial tail stays buffered.
-  void CountFrames() {
+  // Returns false on a framing desync — the caller must treat the
+  // connection as dead (close the fd, drop it from epoll).
+  bool CountFrames() {
+    if (closed) return false;
     size_t pos = 0;
+    bool ok = true;
     while (rbuf.size() - pos >= 5) {
-      size_t size = (size_t(uint8_t(rbuf[pos + 2])) << 8) |
-                    uint8_t(rbuf[pos + 3]);
+      const uint8_t* p = reinterpret_cast<const uint8_t*>(rbuf.data()) + pos;
+      if (p[0] != 'C') {  // desync is fatal: counting garbage is worse
+        ok = false;       // than losing the connection's stats
+        break;
+      }
+      size_t size;
+      if (p[1] != 'H') {
+        // Same 3-byte size escape the SDK decodes: server->client frames
+        // over 64KB carry the top size byte in byte 1 (framing.py).
+        size = (size_t(p[1]) << 16) | (size_t(p[2]) << 8) | p[3];
+        if (size >= 0x480000) {  // framing.py's 'CH' collision hole:
+          ok = false;            // stream-fatal there, so fatal here too
+          break;
+        }
+      } else {
+        size = (size_t(p[2]) << 8) | p[3];
+      }
       if (rbuf.size() - pos < 5 + size) break;
       pos += 5 + size;
       frames_in++;
     }
     rbuf.erase(0, pos);
+    if (!ok) {
+      closed = true;
+      rbuf.clear();  // nothing after a desync is trustworthy
+    }
+    return ok;
   }
 
   // Frame-atomic non-blocking send; stashes the unsent TAIL.
@@ -206,7 +230,13 @@ int main(int argc, char** argv) {
       }
       c.rbuf.append(buf, size_t(n));
       long before = c.frames_in;
-      c.CountFrames();
+      if (!c.CountFrames()) {  // desync: this conn can never auth
+        epoll_ctl(ep, EPOLL_CTL_DEL, c.fd, nullptr);
+        close(c.fd);
+        c.fd = -1;
+        live--;
+        continue;
+      }
       if (c.frames_in > before && !c.authed) {
         c.authed = true;
         authed++;
@@ -247,7 +277,11 @@ int main(int argc, char** argv) {
         continue;
       }
       c.rbuf.append(buf, size_t(n));
-      c.CountFrames();
+      if (!c.CountFrames()) {
+        epoll_ctl(ep, EPOLL_CTL_DEL, c.fd, nullptr);
+        close(c.fd);
+        c.fd = -1;
+      }
     }
   }
   double elapsed = MonoNow() - t0;

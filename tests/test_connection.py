@@ -362,3 +362,89 @@ def test_full_queue_stashes_instead_of_dropping():
     assert conn.flush_pending()
     assert not conn.has_pending()
     assert gch.in_msg_queue.qsize() == 3
+
+
+def test_fsm_transition_deferred_until_enqueue_succeeds():
+    """A msg-type-triggered FSM transition must not fire on a queue-full
+    attempt: the stash/retry contract re-enters receive_message with the
+    same pack, and a transition applied on the failed attempt would make
+    the retry disallowed by the state its own first attempt advanced
+    (advisor r3, medium)."""
+    from channeld_tpu.core import channel as channel_mod
+    from channeld_tpu.core.channel import get_global_channel
+
+    transport = FakeTransport()
+    conn = connection_mod.add_connection(transport, ConnectionType.CLIENT)
+    conn.on_bytes(wire(MessageType.AUTH, control_pb2.AuthMessage(
+        playerIdentifierToken="pit-fsm", loginToken="lt")))
+    gch = get_global_channel()
+    gch.tick_once()
+
+    # Type 100 transitions OPEN -> LOCKED, and LOCKED disallows 100: a
+    # premature transition makes the retried message drop itself.
+    conn.fsm = MessageFsm.from_dict({
+        "States": [
+            {"Name": "OPEN", "MsgTypeWhitelist": "2-65535",
+             "MsgTypeBlacklist": ""},
+            {"Name": "LOCKED", "MsgTypeWhitelist": "2-99",
+             "MsgTypeBlacklist": ""},
+        ],
+        "Transitions": [
+            {"FromState": "OPEN", "ToState": "LOCKED", "MsgType": 100},
+        ],
+    })
+
+    filler = wire(101, control_pb2.AuthMessage())
+    baseline = gch.in_msg_queue.qsize()
+    for _ in range(channel_mod.QUEUE_CAPACITY - baseline):
+        conn.on_bytes(filler)
+    assert gch.in_msg_queue.qsize() == channel_mod.QUEUE_CAPACITY
+
+    conn.on_bytes(wire(100, control_pb2.AuthMessage()))
+    assert conn.has_pending()
+    assert conn.fsm.current.name == "OPEN"  # NOT advanced on the failure
+
+    gch.tick_once()
+    assert conn.flush_pending()
+    assert gch.in_msg_queue.qsize() == 1  # the retried message enqueued
+    assert conn.fsm.current.name == "LOCKED"  # transition fired exactly once
+
+
+def test_packet_dropped_counted_once_per_packet_across_stash_flush():
+    """packet_dropped is a packet-level counter (reference parity): a
+    packet that drops a message in on_bytes and drops another when its
+    stashed tail flushes must increment the counter exactly once
+    (advisor r3, low)."""
+    from channeld_tpu.core import channel as channel_mod
+    from channeld_tpu.core.channel import get_global_channel
+
+    transport = FakeTransport()
+    conn = connection_mod.add_connection(transport, ConnectionType.CLIENT)
+    conn.on_bytes(wire(MessageType.AUTH, control_pb2.AuthMessage(
+        playerIdentifierToken="pit-drop", loginToken="lt")))
+    gch = get_global_channel()
+    gch.tick_once()
+
+    filler = wire(101, control_pb2.AuthMessage())
+    baseline = gch.in_msg_queue.qsize()
+    for _ in range(channel_mod.QUEUE_CAPACITY - baseline):
+        conn.on_bytes(filler)
+
+    # One packet, three messages: [drop (unknown channel), enqueue-full
+    # (stash), drop (unknown channel)]. The first drop counts; the tail
+    # stashes; the flush-time drop must NOT count again.
+    body = control_pb2.AuthMessage().SerializeToString()
+    p = wire_pb2.Packet(messages=[
+        wire_pb2.MessagePack(channelId=999, msgType=101, msgBody=body),
+        wire_pb2.MessagePack(channelId=0, msgType=101, msgBody=body),
+        wire_pb2.MessagePack(channelId=999, msgType=101, msgBody=body),
+    ])
+    before = conn._m_packet_dropped._value.get()
+    conn.on_bytes(encode_packet(p))
+    assert conn.has_pending()
+    assert conn._m_packet_dropped._value.get() == before + 1
+
+    gch.tick_once()
+    assert conn.flush_pending()
+    assert not conn.has_pending()
+    assert conn._m_packet_dropped._value.get() == before + 1
