@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import asyncio
 import time
+from collections import deque
 from dataclasses import dataclass
 from enum import IntEnum
 from typing import Callable, Optional
@@ -33,6 +34,10 @@ from .settings import global_settings
 from .types import BroadcastType, ChannelType, ConnectionType, GLOBAL_CHANNEL_ID, MessageType
 
 logger = get_logger("channel")
+
+# Hot-path handles bound lazily (circular imports).
+_MessageContext = None
+_connection_mod = None
 
 # Channels whose in-queues are above the high watermark. A reactor pauses
 # reading from a connection only while a channel *that connection* fed is
@@ -83,6 +88,20 @@ class ChannelState(IntEnum):
     HANDOVER = 2
 
 
+class _MsgQueue(deque):
+    """Deque with asyncio.Queue's non-blocking surface (qsize / empty /
+    put_nowait / get_nowait) so call sites and tests keep reading the
+    same way. Blocking gets were never used — the tick loop wakes via
+    the channel's ``_wake`` event."""
+
+    qsize = deque.__len__
+    put_nowait = deque.append
+    get_nowait = deque.popleft
+
+    def empty(self) -> bool:
+        return not self
+
+
 @dataclass
 class _QueuedMessage:
     ctx: "object"  # MessageContext; None for pure callables
@@ -100,9 +119,12 @@ class Channel:
         self.latest_data_update_conn_id = 0
         self.spatial_notifier = None
         self.entity_controller = None
-        # Unbounded asyncio.Queue; the external-put bound (QUEUE_CAPACITY)
-        # is enforced in _enqueue so internal puts keep a reserve.
-        self.in_msg_queue: asyncio.Queue = asyncio.Queue()
+        # Unbounded deque with the asyncio.Queue method surface; the
+        # external-put bound (QUEUE_CAPACITY) is enforced in _enqueue so
+        # internal puts keep a reserve. A plain deque because nothing ever
+        # awaits it (the tick loop wakes via _wake) and asyncio.Queue's
+        # put/get bookkeeping was measurable at load-test rates.
+        self.in_msg_queue: _MsgQueue = _MsgQueue()
         self.fan_out_queue: list[FanOutConnection] = []
         # Spatial channels with a TPU controller: engine sub-table slot ->
         # FanOutConnection, for consuming the batched device due mask;
@@ -194,9 +216,10 @@ class Channel:
         does)."""
         if self.is_removing():
             return True  # channel dying: message vanishes, like the ref
-        from .message import MessageContext
-
-        ctx = MessageContext(
+        global _MessageContext
+        if _MessageContext is None:  # late bind once (circular import)
+            from .message import MessageContext as _MessageContext
+        ctx = _MessageContext(
             msg_type=pack.msgType,
             msg=msg,
             connection=conn,
@@ -208,6 +231,51 @@ class Channel:
             raw_body=raw_body,
         )
         return self._enqueue(_QueuedMessage(ctx, handler), external=external)
+
+    def put_forward_batch(self, entries: list, conn) -> bool:
+        """Enqueue one batched-ingest run (pre-encoded owner send-queue
+        entries from the native parse_forward path) as a single queue
+        item. Semantics match N put_message calls whose handler is
+        handle_client_to_server_user_message with broadcast=0: the owner
+        resolves at tick time, mid-recovery owners drop, ownerless
+        channels warn. False = queue full (caller stashes)."""
+        if self.is_removing():
+            return True  # channel dying: messages vanish, like the ref
+        global _MessageContext
+        if _MessageContext is None:
+            from .message import MessageContext as _MessageContext
+        ctx = _MessageContext(connection=conn, channel=self)
+        return self._enqueue(
+            _QueuedMessage(
+                ctx, lambda _ctx, e=entries: self._deliver_forward_batch(e)
+            ),
+            external=True,
+        )
+
+    def _deliver_forward_batch(self, entries: list) -> None:
+        owner = self.get_owner()
+        if owner is not None and not owner.is_closing():
+            if owner.should_recover():
+                # Owner mid-recovery: client updates are dropped
+                # (ref: message.go:72-80).
+                return
+            owner.send_queue.extend(entries)
+            global _connection_mod
+            if _connection_mod is None:
+                from . import connection as _connection_mod
+            # Resolve the set through the module: drain_pending_flush
+            # swaps in a fresh set every pump cycle.
+            _connection_mod._pending_flush.add(owner)
+        else:
+            # Rate-limited like the per-message ownerless path.
+            now = time.monotonic()
+            if now - getattr(self, "_ownerless_warn_at", 0.0) > 1.0:
+                self._ownerless_warn_at = now
+                self.logger.warning(
+                    "channel has no owner to forward to (suppressing "
+                    "repeats for 1s; %d batched messages dropped)",
+                    len(entries),
+                )
 
     def put_message_context(self, ctx, handler) -> None:
         if self.is_removing():
@@ -248,11 +316,11 @@ class Channel:
         lost). Internal puts (execute callbacks, owner-side messages) ride
         a reserve above the cap: they are control-plane, self-limited, and
         dropping them would corrupt channel state."""
-        size = self.in_msg_queue.qsize()
+        size = len(self.in_msg_queue)
         if external and size >= QUEUE_CAPACITY:
             self._mark_congested(qm)
             return False
-        self.in_msg_queue.put_nowait(qm)
+        self.in_msg_queue.append(qm)
         self._wake.set()
         if size + 1 >= _HIGH_WATERMARK:
             self._mark_congested(qm)
@@ -364,6 +432,13 @@ class Channel:
                 controller.tick()
 
         self.tick_frames += 1
+        # Deferred ingest runs land in the queue before it drains, so a
+        # tick never misses traffic the per-read dispatch would have
+        # delivered (also what keeps on_bytes + tick_once tests exact).
+        global _connection_mod
+        if _connection_mod is None:
+            from . import connection as _connection_mod
+        _connection_mod.flush_pending_ingest()
         self._tick_messages(tick_start)
         fanout_start = time.monotonic()
         tick_data(self, now)
@@ -377,8 +452,9 @@ class Channel:
     def _tick_messages(self, tick_start: float) -> None:
         """Drain the queue within the tick budget (ref: channel.go:389-412)."""
         try:
-            while not self.in_msg_queue.empty():
-                qm = self.in_msg_queue.get_nowait()
+            queue = self.in_msg_queue
+            while queue:
+                qm = queue.popleft()
                 # One bad message must never kill the channel task: isolate
                 # every handler (internal puts may carry no connection —
                 # e.g. RemoveChannel after owner loss — handlers guard

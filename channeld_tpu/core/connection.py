@@ -15,6 +15,8 @@ import time
 from collections import deque
 from typing import Callable, Optional, Protocol
 
+from google.protobuf.message import DecodeError as _DecodeError
+
 from ..protocol import FramingError, MESSAGE_TEMPLATES, encode_frame, wire_pb2
 
 try:
@@ -36,6 +38,27 @@ from .types import (
 )
 
 logger = get_logger("connection")
+
+# Hot-path handles resolved lazily by receive_message (circular imports
+# prevent binding them at module import time).
+_get_channel = None
+_MESSAGE_MAP = None
+_handle_c2s_user = None
+_handle_s2c_user = None
+
+
+class _ForwardBatch:
+    """One batched-ingest run: pre-encoded owner send-queue entries for
+    plain user-space forwards to GLOBAL, produced by the native codec's
+    parse_forward. Travels through receive_message / the pending stash
+    like a MessagePack so ordering and backpressure semantics hold."""
+
+    __slots__ = ("entries", "counts", "n_packets")
+
+    def __init__(self, entries: list, counts: dict, n_packets: int):
+        self.entries = entries
+        self.counts = counts  # msgType -> n, for metrics attribution
+        self.n_packets = n_packets
 
 
 class Transport(Protocol):
@@ -87,15 +110,21 @@ class QueuedMessagePackSender:
 
     def send(self, conn: "Connection", ctx) -> None:
         body = ctx.raw_body if ctx.raw_body is not None else ctx.msg.SerializeToString()
-        if _pack_size(ctx, len(body)) >= MAX_PACKET_SIZE - HEADER_SIZE:
+        # Exact size math only near the limit: the entry overhead beyond
+        # the body is at most 4 varint fields (6 bytes each) + the
+        # body/entry length prefixes — well under 64 bytes.
+        if (len(body) + 64 >= MAX_PACKET_SIZE - HEADER_SIZE
+                and _pack_size(ctx, len(body)) >= MAX_PACKET_SIZE - HEADER_SIZE):
             conn.logger.warning(
                 "message dropped: size %d exceeds packet limit", len(body)
             )
             return
         if not conn.is_closing():
+            # No int() casts: enum values are int subclasses and both
+            # packet encoders take them as-is.
             conn.send_queue.append(
-                (int(ctx.channel_id), int(ctx.broadcast), int(ctx.stub_id),
-                 int(ctx.msg_type), body)
+                (ctx.channel_id, ctx.broadcast, ctx.stub_id,
+                 ctx.msg_type, body)
             )
             _pending_flush.add(conn)
 
@@ -145,6 +174,12 @@ class Connection:
             conn_type=ct_name, channel_type="", msg_type=""
         )
         self._m_msg_received: dict[tuple, object] = {}
+        # (channel_type, msgType) -> count since the last publish; see
+        # _publish_msg_received.
+        self._msg_received_pending: dict[tuple, int] = {}
+        # Deferred fast-path run [entries, counts, n_packets]; dispatched
+        # by flush_ingest (1ms pump / channel tick / ordering points).
+        self._fast_run: Optional[list] = None
         if self._is_packet_recording_enabled():
             from ..replay.session import ReplaySession
 
@@ -156,8 +191,8 @@ class Connection:
         """Feed raw stream bytes; dispatches every complete packet.
         Fatal framing/parse errors close the connection (ref: readPacket)."""
         try:
-            packets = self.decoder.decode_packets(data)
-        except Exception as e:  # framing violations and protobuf DecodeError alike
+            bodies = self.decoder.feed(data)
+        except Exception as e:  # framing violations are connection-fatal
             self.logger.warning("bad inbound frame, closing connection: %s", e)
             metrics.connection_closed.labels(
                 conn_type=self.connection_type.name
@@ -173,33 +208,112 @@ class Connection:
             and self.compression_type == CompressionType.NO_COMPRESSION
         ):
             self.compression_type = CompressionType.SNAPPY
-        for packet in packets:
-            self._m_packet_received.inc()
-            if self._is_packet_recording_enabled() and self.replay_session is not None:
-                self.replay_session.record(packet)
-            # One token per packet: packet_dropped increments at most once
-            # per originating packet, whether the drop happens here or
-            # later when a stashed tail flushes.
-            drop_token = [False]
-            for i, mp in enumerate(packet.messages):
-                if self._pending_msgs:
-                    # Order must hold: once anything is stashed, every
-                    # later message queues behind it.
-                    self._pending_msgs.extend(
-                        (m, drop_token) for m in packet.messages[i:]
-                    )
-                    break
-                result = self.receive_message(mp)
-                if result is None:  # target queue full: stash, not drop
-                    self._pending_msgs.extend(
-                        (m, drop_token) for m in packet.messages[i:]
-                    )
-                    break
-                if not result and not drop_token[0]:
-                    # Counted once per packet (the reference's packet-level
-                    # dropped counter), whatever the drop reason.
-                    drop_token[0] = True
-                    self._m_packet_dropped.inc()
+        if not bodies:
+            return
+        recording = (self._is_packet_recording_enabled()
+                     and self.replay_session is not None)
+        # The batched ingest path: packets that are nothing but plain
+        # user-space forwards to GLOBAL skip protobuf entirely — the
+        # native codec emits ready-to-queue owner entries, accumulated
+        # across consecutive packets into one channel-queue item.
+        parse_forward = getattr(_native_codec, "parse_forward", None)
+        fast_eligible = (
+            parse_forward is not None  # guards a stale codec build too
+            and not recording
+            and self.connection_type == ConnectionType.CLIENT
+        )
+        receive_message = self.receive_message
+        pending_msgs = self._pending_msgs
+        self._m_packet_received.inc(len(bodies))
+        fsm = self.fsm
+        conn_id = self.id
+        try:
+            for body in bodies:
+                if fast_eligible and not pending_msgs:
+                    res = parse_forward(body, conn_id, 0, 100)
+                    if res is not None and (
+                        fsm is None or fsm.user_space_fast(res[1])
+                    ):
+                        # Defer dispatch to the 1ms pump (or the next
+                        # channel tick, whichever first): singleton reads
+                        # then share one channel-queue hop instead of
+                        # paying it per read. Ordering holds — a slow
+                        # body below flushes the deferred run first.
+                        run = self._fast_run
+                        if run is None:
+                            self._fast_run = [res[0], res[1], 1]
+                            _pending_ingest.add(self)
+                        else:
+                            run[0].extend(res[0])
+                            rc = run[1]
+                            for mt, n in res[1].items():
+                                rc[mt] = rc.get(mt, 0) + n
+                            run[2] += 1
+                        continue
+                if self._fast_run is not None:
+                    self.flush_ingest()
+                packet = wire_pb2.Packet()
+                packet.ParseFromString(body)  # DecodeError -> close below
+                if recording:
+                    self.replay_session.record(packet)
+                # One token per packet: packet_dropped increments at most
+                # once per originating packet, whether the drop happens
+                # here or later when a stashed tail flushes.
+                drop_token = [False]
+                for i, mp in enumerate(packet.messages):
+                    if pending_msgs:
+                        # Order must hold: once anything is stashed, every
+                        # later message queues behind it.
+                        pending_msgs.extend(
+                            (m, drop_token) for m in packet.messages[i:]
+                        )
+                        break
+                    result = receive_message(mp)
+                    if result is None:  # target queue full: stash, not drop
+                        pending_msgs.extend(
+                            (m, drop_token) for m in packet.messages[i:]
+                        )
+                        break
+                    if not result and not drop_token[0]:
+                        # Counted once per packet (the reference's
+                        # packet-level dropped counter), whatever the
+                        # drop reason.
+                        drop_token[0] = True
+                        self._m_packet_dropped.inc()
+        except _DecodeError as e:  # bad protobuf: connection-fatal. Other
+            # exceptions (handler/event bugs) must propagate so the
+            # transport layer closes with unexpected=True and recoverable
+            # server conns stay eligible for recovery.
+            self.logger.warning("bad inbound packet, closing connection: %s", e)
+            metrics.connection_closed.labels(
+                conn_type=self.connection_type.name
+            ).inc()
+            self.close()
+            return
+        self._publish_msg_received()
+
+    def flush_ingest(self) -> None:
+        """Dispatch the deferred fast-path run, if any. Called by the
+        1ms pump / channel tick, and inline whenever ordering demands it
+        (a slow body or a close)."""
+        run = self._fast_run
+        if run is None:
+            return
+        self._fast_run = None
+        self._dispatch_forward_run(run)
+        self._publish_msg_received()
+
+    def _dispatch_forward_run(self, run: list) -> None:
+        """Hand one accumulated fast-path run to the channel queue,
+        with the same stash/drop accounting as per-message dispatch."""
+        batch = _ForwardBatch(run[0], run[1], run[2])
+        result = self.receive_message(batch)
+        if result is None:  # queue full: stash for flush_pending
+            self._pending_msgs.append((batch, [False]))
+        elif result is False:
+            # The whole run failed (no target channel): one drop per
+            # originating packet, like the per-message path.
+            self._m_packet_dropped.inc(run[2])
 
     def has_pending(self) -> bool:
         return bool(self._pending_msgs)
@@ -212,11 +326,15 @@ class Connection:
             mp, drop_token = self._pending_msgs[0]
             result = self.receive_message(mp)
             if result is None:
+                self._publish_msg_received()
                 return False
             self._pending_msgs.popleft()
             if result is False and not drop_token[0]:
                 drop_token[0] = True
-                self._m_packet_dropped.inc()
+                self._m_packet_dropped.inc(
+                    mp.n_packets if type(mp) is _ForwardBatch else 1
+                )
+        self._publish_msg_received()
         return True
 
     def receive_message(self, mp: wire_pb2.MessagePack):
@@ -226,12 +344,39 @@ class Connection:
         the pack and retry once backpressure drains
         (ref: connection.go:547-615; the reference's blocking queue send
         maps to the stash + paused reads)."""
-        from .channel import get_channel
-        from .message import (
-            MESSAGE_MAP,
-            handle_client_to_server_user_message,
-            handle_server_to_client_user_message,
-        )
+        global _get_channel, _MESSAGE_MAP, _handle_c2s_user, _handle_s2c_user
+        if _get_channel is None:
+            # One-time late binding (circular-import-safe); the previous
+            # per-call ``from .channel import ...`` form ran the import
+            # machinery ~650K times in a 27s load profile.
+            from .channel import get_channel as _gc
+            from .message import (
+                MESSAGE_MAP as _mm,
+                handle_client_to_server_user_message as _c2s,
+                handle_server_to_client_user_message as _s2c,
+            )
+            _get_channel, _MESSAGE_MAP = _gc, _mm
+            _handle_c2s_user, _handle_s2c_user = _c2s, _s2c
+        get_channel = _get_channel
+        MESSAGE_MAP = _MESSAGE_MAP
+        handle_client_to_server_user_message = _handle_c2s_user
+        handle_server_to_client_user_message = _handle_s2c_user
+
+        if type(mp) is _ForwardBatch:
+            # Batched ingest run: FSM verdicts were checked at parse time
+            # (user_space_fast: allowed, no transitions), so only the
+            # channel hop and metrics attribution remain.
+            channel = get_channel(0)
+            if channel is None:
+                return False
+            if not channel.put_forward_batch(mp.entries, self):
+                return None  # queue full: caller stashes and retries
+            pending = self._msg_received_pending
+            ct = channel.channel_type
+            for mt, n in mp.counts.items():
+                key = (ct, mt)
+                pending[key] = pending.get(key, 0) + n
+            return True
 
         channel = get_channel(mp.channelId)
         if channel is None:
@@ -306,16 +451,28 @@ class Connection:
         # twice or make the retry disallowed by its own first attempt.
         if self.fsm is not None:
             self.fsm.on_received(mp.msgType)
+        # Deferred inc: prometheus child.inc() takes a mutex per call;
+        # accumulate per (channel_type, msgType) and let the read-batch
+        # boundary (on_bytes / flush_pending) publish the counts.
         key = (channel.channel_type, mp.msgType)
-        child = self._m_msg_received.get(key)
-        if child is None:
-            child = self._m_msg_received[key] = metrics.msg_received.labels(
-                conn_type=self.connection_type.name,
-                channel_type=channel.channel_type.name,
-                msg_type=str(mp.msgType),
-            )
-        child.inc()
+        pending = self._msg_received_pending
+        pending[key] = pending.get(key, 0) + 1
         return True
+
+    def _publish_msg_received(self) -> None:
+        pending = self._msg_received_pending
+        if not pending:
+            return
+        self._msg_received_pending = {}
+        for key, count in pending.items():
+            child = self._m_msg_received.get(key)
+            if child is None:
+                child = self._m_msg_received[key] = metrics.msg_received.labels(
+                    conn_type=self.connection_type.name,
+                    channel_type=key[0].name,
+                    msg_type=str(key[1]),
+                )
+            child.inc(count)
 
     # ---- send path -------------------------------------------------------
 
@@ -418,6 +575,7 @@ class Connection:
         except Exception:
             pass
         self.send_queue.clear()
+        self._fast_run = None  # in-flight inbound dies with the conn
         _all_connections.pop(self.id, None)
         from .ddos import untrack_unauthenticated
 
@@ -583,12 +741,39 @@ def all_connections() -> dict[int, Connection]:
 # mostly-idle connections the scan is the asyncio analog's hot spot).
 _pending_flush: set["Connection"] = set()
 
+# Connections holding a deferred fast-path ingest run (see flush_ingest).
+_pending_ingest: set["Connection"] = set()
+
 
 def drain_pending_flush() -> set["Connection"]:
     """Hand the pending set to the pump and start a fresh one."""
     global _pending_flush
     pending, _pending_flush = _pending_flush, set()
     return pending
+
+
+# Connections whose ingest dispatch stashed (queue full) from a pump- or
+# tick-time flush, where no transport drain task exists to retry: the
+# pump retries flush_pending until the stash drains (the transport-side
+# _drain task covers the read-triggered case).
+_stash_retry: set["Connection"] = set()
+
+
+def flush_pending_ingest() -> None:
+    """Dispatch every deferred ingest run (1ms pump and channel ticks)."""
+    global _pending_ingest
+    if _stash_retry:
+        for conn in list(_stash_retry):
+            if conn.is_closing() or conn.flush_pending():
+                _stash_retry.discard(conn)
+    if not _pending_ingest:
+        return
+    pending, _pending_ingest = _pending_ingest, set()
+    for conn in pending:
+        if not conn.is_closing():
+            conn.flush_ingest()
+            if conn.has_pending():
+                _stash_retry.add(conn)
 
 
 def flush_all() -> None:
@@ -604,4 +789,6 @@ def reset_connections() -> None:
         conn.state = ConnectionState.CLOSING
     _all_connections.clear()
     _pending_flush.clear()
+    _pending_ingest.clear()
+    _stash_retry.clear()
     _next_connection_id = 0

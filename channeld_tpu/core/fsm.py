@@ -25,15 +25,29 @@ from typing import Optional
 
 from ..utils.ranges import RangeSet
 
+# First user-space message type (ref: channeld.pb USER_SPACE_START);
+# kept as a local constant so the FSM stays importable on its own.
+USER_SPACE_START = 100
+
 
 @dataclass
 class FsmState:
     name: str
     allowed: RangeSet = field(default_factory=RangeSet)
     blocked: RangeSet = field(default_factory=RangeSet)
+    # msg_type -> verdict memo. The range sets are immutable after load
+    # and states are shared across per-connection clones, so one warm
+    # cache serves every connection (two bisect walks per message
+    # otherwise dominate the FSM's share of the receive path).
+    _memo: dict = field(default_factory=dict, repr=False, compare=False)
 
     def is_allowed(self, msg_type: int) -> bool:
-        return msg_type in self.allowed and msg_type not in self.blocked
+        v = self._memo.get(msg_type)
+        if v is None:
+            v = self._memo[msg_type] = (
+                msg_type in self.allowed and msg_type not in self.blocked
+            )
+        return v
 
 
 class MessageFsm:
@@ -48,6 +62,18 @@ class MessageFsm:
         self.states = states
         self._by_name = {s.name: s for s in states}
         self.transitions = transitions
+        # Per-state transition table (msg_type -> target name): saves the
+        # per-message (name, msg_type) tuple build in on_received.
+        self._state_transitions: list[dict[int, str]] = [
+            {mt: to for (frm, mt), to in transitions.items() if frm == s.name}
+            for s in states
+        ]
+        # Whether any transition out of each state is triggered by a
+        # user-space msgType; gates the batched-ingest fast path.
+        self._state_user_transitions: list[bool] = [
+            any(mt >= USER_SPACE_START for mt in table)
+            for table in self._state_transitions
+        ]
         self._init_index = 0
         if init_state is not None:
             if init_state not in self._by_name:
@@ -95,9 +121,24 @@ class MessageFsm:
 
     def on_received(self, msg_type: int) -> None:
         """Apply a msg-type-triggered transition, if one is defined."""
-        target = self.transitions.get((self.current.name, msg_type))
-        if target is not None:
-            self._move_to(target)
+        table = self._state_transitions[self._current_index]
+        if table:
+            target = table.get(msg_type)
+            if target is not None:
+                self._move_to(target)
+
+    def user_space_fast(self, msg_types) -> bool:
+        """True when every msgType in ``msg_types`` is allowed in the
+        current state and none can trigger a transition — the batched
+        ingest path may then skip per-message FSM work (the per-message
+        outcome would be: allowed, no state change)."""
+        if self._state_user_transitions[self._current_index]:
+            return False
+        is_allowed = self.states[self._current_index].is_allowed
+        for mt in msg_types:
+            if not is_allowed(mt):
+                return False
+        return True
 
     def move_to_next_state(self) -> bool:
         """Advance to the next state in declaration order (auth success path)."""

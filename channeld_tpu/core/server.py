@@ -21,11 +21,12 @@ from typing import Optional
 
 from ..utils.logger import get_logger, init_logs
 from . import events
-from .channel import init_channels
+from .channel import congestion_wait, connection_congested, init_channels
 from .connection import (
     Connection,
     add_connection,
     drain_pending_flush,
+    flush_pending_ingest,
     init_connections,
 )
 from .connection_recovery import connection_recovery_loop
@@ -45,33 +46,111 @@ MAX_SEND_BUFFER = 4 * 1024 * 1024
 
 
 class TcpTransport:
-    def __init__(self, writer: asyncio.StreamWriter):
-        self.writer = writer
+    """Byte sink over a raw asyncio.Transport (no StreamWriter layer)."""
+
+    def __init__(self, transport: asyncio.Transport):
+        self.transport = transport
         try:
-            writer.transport.set_write_buffer_limits(high=MAX_SEND_BUFFER)
+            transport.set_write_buffer_limits(high=MAX_SEND_BUFFER)
         except (AttributeError, NotImplementedError):
             pass
 
     def write(self, data: bytes) -> None:
-        if self.writer.is_closing():
+        t = self.transport
+        if t.is_closing():
             return
         try:
-            buffered = self.writer.transport.get_write_buffer_size()
+            buffered = t.get_write_buffer_size()
         except (AttributeError, NotImplementedError):
             buffered = 0
         if buffered + len(data) > MAX_SEND_BUFFER:
             logger.warning("tcp peer %s too slow (%d bytes unsent); closing",
                            self.remote_addr(), buffered)
-            self.writer.close()
+            t.close()
             return
-        self.writer.write(data)
+        t.write(data)
 
     def close(self) -> None:
-        if not self.writer.is_closing():
-            self.writer.close()
+        if not self.transport.is_closing():
+            self.transport.close()
 
     def remote_addr(self) -> Optional[tuple]:
-        return self.writer.get_extra_info("peername")
+        return self.transport.get_extra_info("peername")
+
+
+class _TcpServerProtocol(asyncio.Protocol):
+    """Raw-protocol TCP receive path. The previous streams-based reactor
+    paid a Future + task switch per read; at 10K mostly-1-message reads
+    per second that machinery was a measurable share of the per-message
+    budget. Backpressure keeps the reference semantics (a congested
+    channel pauses exactly the connection that fed it,
+    ref: channel.go:295-310) via transport.pause_reading()."""
+
+    __slots__ = ("conn_type", "conn", "transport", "_draining")
+
+    def __init__(self, conn_type: ConnectionType):
+        self.conn_type = conn_type
+        self.conn: Optional[Connection] = None
+        self.transport: Optional[asyncio.Transport] = None
+        self._draining = False
+
+    def connection_made(self, transport: asyncio.Transport) -> None:
+        self.transport = transport
+        sock = transport.get_extra_info("socket")
+        if sock is not None:
+            import socket as _socket
+
+            try:
+                sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
+        try:
+            self.conn = add_connection(TcpTransport(transport), self.conn_type)
+        except ConnectionRefusedError:
+            transport.abort()
+
+    def data_received(self, data: bytes) -> None:
+        conn = self.conn
+        if conn is None:
+            return
+        conn.on_bytes(data)
+        if conn.is_closing():
+            self.transport.close()
+            return
+        if conn.has_pending() or connection_congested(conn):
+            # Stop reading from *this* socket until the stash drains —
+            # TCP backpressure, like the reference's blocking queue send.
+            try:
+                self.transport.pause_reading()
+            except RuntimeError:
+                return
+            if not self._draining:
+                self._draining = True
+                asyncio.ensure_future(self._drain())
+
+    async def _drain(self) -> None:
+        conn = self.conn
+        try:
+            while not conn.is_closing() and (
+                conn.has_pending() or connection_congested(conn)
+            ):
+                await congestion_wait(conn)
+                if conn.has_pending() and not conn.flush_pending():
+                    await asyncio.sleep(0)  # still full; wait again
+        finally:
+            self._draining = False
+            if conn.is_closing():
+                self.transport.close()
+            elif not self.transport.is_closing():
+                try:
+                    self.transport.resume_reading()
+                except RuntimeError:
+                    pass
+
+    def connection_lost(self, exc) -> None:
+        # EOF/error: an unexpected close from the peer's side.
+        if self.conn is not None:
+            self.conn.close(unexpected=True)
 
 
 class WebSocketTransport:
@@ -133,23 +212,13 @@ async def start_listening(conn_type: ConnectionType, network: str, addr: str):
     """(ref: connection.go:186-242). Returns the server object."""
     host, port = _parse_addr(addr)
     if network == "tcp":
-        async def on_client(reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
-            try:
-                sock = writer.get_extra_info("socket")
-                if sock is not None:
-                    import socket as _socket
-
-                    sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
-                conn = add_connection(TcpTransport(writer), conn_type)
-            except ConnectionRefusedError:
-                writer.close()
-                return
-            await _reactor(conn, reader)
-
         # Deep accept backlog: a connect storm (10K clients joining after
         # a match start) must queue, not get RSTs (the reference's
         # listener inherits Go's somaxconn-sized backlog).
-        server = await asyncio.start_server(on_client, host, port, backlog=4096)
+        loop = asyncio.get_running_loop()
+        server = await loop.create_server(
+            lambda: _TcpServerProtocol(conn_type), host, port, backlog=4096
+        )
         logger.info("listening for %s on tcp %s:%d", conn_type.name, host, port)
         return server
     elif network in ("ws", "websocket"):
@@ -303,34 +372,6 @@ async def start_listening(conn_type: ConnectionType, network: str, addr: str):
     raise ValueError(f"unsupported network type: {network}")
 
 
-async def _reactor(conn: Connection, reader: asyncio.StreamReader) -> None:
-    """Per-connection receive loop (ref: the per-conn recv goroutine)."""
-    from .channel import congestion_wait, connection_congested
-
-    try:
-        while not conn.is_closing():
-            data = await reader.read(65536)
-            if not data:
-                break
-            conn.on_bytes(data)
-            # A channel this connection fed is congested (or outright
-            # full: messages are stashed, never dropped): stop reading
-            # from *this* socket until it drains, then re-dispatch the
-            # stash — TCP backpressure, like the reference's blocking
-            # queue send (channel.go:295-310).
-            while not conn.is_closing() and (
-                conn.has_pending() or connection_congested(conn)
-            ):
-                await congestion_wait(conn)
-                if conn.has_pending() and not conn.flush_pending():
-                    await asyncio.sleep(0)  # still full; wait again
-    except (ConnectionResetError, asyncio.IncompleteReadError, OSError):
-        pass
-    finally:
-        # EOF/error: an unexpected close from the peer's side.
-        conn.close(unexpected=True)
-
-
 async def flush_loop(interval: float = 0.001) -> None:
     """Shared send pump (ref: the per-conn 1ms flush goroutine,
     connection.go:180-184). The 1ms cadence is the packet-coalescing
@@ -340,6 +381,10 @@ async def flush_loop(interval: float = 0.001) -> None:
 
     last_sample = 0.0
     while True:
+        # Inbound first: deferred fast-path runs reach their channel
+        # queue this cycle, so a tick landing between pump cycles sees
+        # them no later than the per-read dispatch would have allowed.
+        flush_pending_ingest()
         for conn in drain_pending_flush():
             if not conn.is_closing() and conn.send_queue:
                 conn.flush()

@@ -324,6 +324,164 @@ static PyObject* codec_encode_packets(PyObject* self, PyObject* args) {
   return Py_BuildValue("(NN)", frames, counts);
 }
 
+// ---- inbound forward fast path ------------------------------------------
+//
+// parse_forward(body, conn_id, expect_channel, min_user_type)
+//   -> None | (entries, counts)
+//
+// Scans one serialized chtpu.Packet. When EVERY message in it is a plain
+// user-space forward (msgType >= min_user_type, broadcast == 0,
+// stubId == 0, channelId == expect_channel, payload small enough to
+// re-pack), returns the owner-bound send-queue entries with the
+// ServerForwardMessage{clientConnId, payload} wrapper already encoded:
+//   entries: list[(channelId, 0, 0, msgType, sfm_bytes)]
+//   counts:  dict[msgType, n]   (for metrics attribution)
+// Any other content — system messages, unknown fields, malformed wire
+// data — returns None and the caller takes the full protobuf path. This
+// removes the per-message Packet/MessagePack/ServerForwardMessage
+// object churn from the gateway's steady-state ingest
+// (ref: the reference parses in Go and forwards via the channel
+// goroutine, connection.go:547-615 + message.go:66-126; this is the
+// same routing decision made in native code).
+
+static bool read_varint(const uint8_t** pp, const uint8_t* end, uint64_t* out) {
+  const uint8_t* p = *pp;
+  uint64_t v = 0;
+  int shift = 0;
+  while (p < end && shift < 64) {
+    uint8_t b = *p++;
+    v |= (uint64_t)(b & 0x7F) << shift;
+    if (!(b & 0x80)) {
+      *pp = p;
+      *out = v;
+      return true;
+    }
+    shift += 7;
+  }
+  return false;
+}
+
+static PyObject* codec_parse_forward(PyObject* self, PyObject* args) {
+  Py_buffer buf;
+  unsigned long conn_id, expect_ch, min_user;
+  if (!PyArg_ParseTuple(args, "y*kkk", &buf, &conn_id, &expect_ch, &min_user))
+    return nullptr;
+
+  const uint8_t* p = static_cast<const uint8_t*>(buf.buf);
+  const uint8_t* end = p + buf.len;
+  PyObject* entries = PyList_New(0);
+  PyObject* counts = PyDict_New();
+  if (!entries || !counts) {
+    Py_XDECREF(entries);
+    Py_XDECREF(counts);
+    PyBuffer_Release(&buf);
+    return nullptr;
+  }
+  bool slow = false, fail = false;
+  std::string sfm;
+
+  while (p < end && !slow && !fail) {
+    if (*p != 0x0A) {  // not Packet.messages: unknown top-level field
+      slow = true;
+      break;
+    }
+    p++;
+    uint64_t mlen = 0;
+    if (!read_varint(&p, end, &mlen) || mlen > (uint64_t)(end - p)) {
+      slow = true;
+      break;
+    }
+    const uint8_t* mend = p + mlen;
+    uint64_t ch = 0, bc = 0, stub = 0, mt = 0, plen = 0;
+    const uint8_t* payload = nullptr;
+    while (p < mend) {
+      uint8_t tag = *p++;
+      bool ok = true;
+      switch (tag) {
+        case 0x08: ok = read_varint(&p, mend, &ch); break;
+        case 0x10: ok = read_varint(&p, mend, &bc); break;
+        case 0x18: ok = read_varint(&p, mend, &stub); break;
+        case 0x20: ok = read_varint(&p, mend, &mt); break;
+        case 0x2A:
+          ok = read_varint(&p, mend, &plen) && plen <= (uint64_t)(mend - p);
+          if (ok) {
+            payload = p;
+            p += plen;
+          }
+          break;
+        default:
+          ok = false;
+      }
+      if (!ok) {
+        slow = true;
+        break;
+      }
+    }
+    if (slow) break;
+    if ((ch | bc | stub | mt) >> 32) {
+      // Over-long varints: protobuf truncates these uint32 fields to 32
+      // bits (a crafted msgType of 2^32+5 IS system message 5 there) —
+      // defer to the protobuf path so both classify identically.
+      slow = true;
+      break;
+    }
+    if (p != mend || mt < min_user || bc || stub || ch != expect_ch ||
+        plen + 96 > MAX_PACKET_SIZE) {
+      // Not a plain forward (or would oversize the outbound pack once
+      // wrapped): let the full path handle the whole packet.
+      slow = true;
+      break;
+    }
+    sfm.clear();
+    if (conn_id) {
+      sfm.push_back((char)0x08);
+      write_varint(sfm, conn_id);
+    }
+    if (plen) {
+      sfm.push_back((char)0x12);
+      write_varint(sfm, plen);
+      sfm.append(reinterpret_cast<const char*>(payload), (size_t)plen);
+    }
+    PyObject* entry = Py_BuildValue("(kkkky#)", expect_ch, 0UL, 0UL,
+                                    (unsigned long)mt, sfm.data(),
+                                    (Py_ssize_t)sfm.size());
+    if (!entry || PyList_Append(entries, entry) < 0) {
+      Py_XDECREF(entry);
+      fail = true;
+      break;
+    }
+    Py_DECREF(entry);
+    PyObject* key = PyLong_FromUnsignedLong((unsigned long)mt);
+    if (!key) {
+      fail = true;
+      break;
+    }
+    PyObject* prev = PyDict_GetItem(counts, key);  // borrowed
+    PyObject* next = PyLong_FromLong(prev ? PyLong_AsLong(prev) + 1 : 1);
+    if (!next || PyDict_SetItem(counts, key, next) < 0) {
+      Py_DECREF(key);
+      Py_XDECREF(next);
+      fail = true;
+      break;
+    }
+    Py_DECREF(key);
+    Py_DECREF(next);
+  }
+
+  PyBuffer_Release(&buf);
+  if (fail) {
+    Py_DECREF(entries);
+    Py_DECREF(counts);
+    return nullptr;
+  }
+  if (slow) {
+    Py_DECREF(entries);
+    Py_DECREF(counts);
+    Py_RETURN_NONE;
+  }
+  return Py_BuildValue("(NN)", entries, counts);
+}
+
 // compress(data: bytes) -> bytes ; uncompress(data: bytes) -> bytes
 static PyObject* codec_compress(PyObject* self, PyObject* args) {
   Py_buffer in;
@@ -386,6 +544,9 @@ static PyMethodDef codec_methods[] = {
      "decode_frames(buf) -> ([(body, compression)], consumed)"},
     {"encode_packets", codec_encode_packets, METH_VARARGS,
      "encode_packets([(chId, bc, stub, mt, body)], compression) -> ([frames], [counts])"},
+    {"parse_forward", codec_parse_forward, METH_VARARGS,
+     "parse_forward(body, conn_id, expect_channel, min_user_type) -> "
+     "None | (entries, counts)"},
     {"compress", codec_compress, METH_VARARGS, "snappy compress"},
     {"uncompress", codec_uncompress, METH_VARARGS, "snappy uncompress"},
     {nullptr, nullptr, 0, nullptr},

@@ -1,0 +1,221 @@
+"""Gateway-edge capture at reference scale: native driver + native owner
+drain + honest per-core CPU accounting.
+
+The reference's headline node target is 10K connections / 100K mps
+(ref: README.md:54). This script measures how close one (or N) gateway
+process(es) on THIS host get, and what each ingested+routed message
+costs in gateway CPU — the number that holds regardless of how many
+cores the host has:
+
+  - per gateway process: /proc/<pid>/stat utime+stime deltas across the
+    steady window -> cpu_us_per_msg (gateway CPU microseconds per
+    ingested message; each ingested message is also routed out, so this
+    is the full in->route->out cost).
+  - offered vs ingested vs routed mps from the gateway's own metrics.
+  - the GLOBAL-owner drain runs as a NATIVE process (load_client mode
+    "owner"): a Python drain thread gets starved on a saturated core
+    and mismeasures (round-5 observation: 773 frames counted while the
+    gateway wrote 91K mps).
+
+Run (single gateway, 10K conns, 100K mps offered):
+  python scripts/gateway_edge_bench.py --conns 10000 --rate 10 \
+      --duration 30
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BIN = os.path.join(REPO, "sdk", "cpp", "load_client")
+CLK = os.sysconf("SC_CLK_TCK")
+
+
+def wait_port(port: int, timeout: float = 30.0) -> bool:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            s = socket.create_connection(("127.0.0.1", port), timeout=1)
+            s.close()
+            return True
+        except OSError:
+            time.sleep(0.2)
+    return False
+
+
+def proc_cpu_seconds(pid: int) -> float:
+    with open(f"/proc/{pid}/stat") as f:
+        parts = f.read().rsplit(") ", 1)[1].split()
+    # utime + stime are fields 14/15 (1-based); after the comm split they
+    # land at index 11/12.
+    return (int(parts[11]) + int(parts[12])) / CLK
+
+
+def fetch_metrics(port: int) -> dict:
+    out: dict[str, float] = {}
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+        ) as r:
+            for line in r.read().decode().splitlines():
+                if line.startswith("#") or not line.strip():
+                    continue
+                key, _, val = line.rpartition(" ")
+                try:
+                    out[key] = float(val)
+                except ValueError:
+                    pass
+    except OSError:
+        pass
+    return out
+
+
+def spawn_gateway(idx: int, base_port: int):
+    ca = base_port + idx * 10
+    sa = ca + 1
+    mport = base_port + 900 + idx
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "channeld_tpu", "-dev", "-loglevel", "2",
+         "-cn", "tcp", "-ca", f":{ca}", "-sn", "tcp", "-sa", f":{sa}",
+         "-cwm", "false", "-mport", str(mport),
+         "-chs", "config/channel_settings_hifi.json",
+         "-imports", "channeld_tpu.compat"],
+        cwd=REPO, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    return {"proc": proc, "ca": ca, "sa": sa, "mport": mport}
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description="gateway edge capture")
+    p.add_argument("--gateways", type=int, default=1)
+    p.add_argument("--conns", type=int, default=10000,
+                   help="total client connections, sharded across gateways")
+    p.add_argument("--rate", type=float, default=10.0,
+                   help="messages per second per connection")
+    p.add_argument("--duration", type=float, default=30.0)
+    p.add_argument("--connect-stagger-us", type=int, default=100)
+    p.add_argument("--driver-nice", type=int, default=5)
+    p.add_argument("--base-port", type=int, default=13100)
+    p.add_argument("--out", default="")
+    args = p.parse_args()
+
+    if not os.path.exists(BIN):
+        print(json.dumps({"error": f"{BIN} missing; run sh sdk/cpp/build.sh"}))
+        raise SystemExit(1)
+
+    gws = []
+    owners = []
+    drivers = []
+    try:
+        for g in range(args.gateways):
+            gws.append(spawn_gateway(g, args.base_port))
+        for gw in gws:
+            if not wait_port(gw["ca"]) or not wait_port(gw["sa"]):
+                raise RuntimeError(f"gateway :{gw['ca']} never came up")
+
+        # Native GLOBAL owners possess first (drain side, niceness 0 so
+        # consumption is never the bottleneck under contention).
+        own_duration = args.duration + 60
+        for gw in gws:
+            owners.append(subprocess.Popen(
+                [BIN, "127.0.0.1", str(gw["sa"]), "1", "0",
+                 str(own_duration), "0", "0", "owner"],
+                stdout=subprocess.PIPE, text=True,
+            ))
+        time.sleep(1.5)
+
+        before_cpu = [proc_cpu_seconds(gw["proc"].pid) for gw in gws]
+        before_met = [fetch_metrics(gw["mport"]) for gw in gws]
+        t0 = time.monotonic()
+
+        per = args.conns // len(gws)
+        for i, gw in enumerate(gws):
+            n = per + (1 if i < args.conns % len(gws) else 0)
+            drivers.append(subprocess.Popen(
+                [BIN, "127.0.0.1", str(gw["ca"]), str(n), str(args.rate),
+                 str(args.duration), str(args.connect_stagger_us),
+                 str(args.driver_nice)],
+                stdout=subprocess.PIPE, text=True,
+            ))
+        driver_out = []
+        for d in drivers:
+            out, _ = d.communicate(timeout=args.duration + 240)
+            driver_out.append(json.loads(out.strip().splitlines()[-1]))
+
+        elapsed = time.monotonic() - t0
+        after_cpu = [proc_cpu_seconds(gw["proc"].pid) for gw in gws]
+        after_met = [fetch_metrics(gw["mport"]) for gw in gws]
+        for o in owners:
+            o.send_signal(signal.SIGINT)
+    finally:
+        for o in owners:
+            try:
+                o.kill()
+            except OSError:
+                pass
+        for gw in gws:
+            gw["proc"].send_signal(signal.SIGINT)
+        for gw in gws:
+            try:
+                gw["proc"].wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                gw["proc"].kill()
+
+    per_gw = []
+    for i, gw in enumerate(gws):
+        delta = {k: after_met[i].get(k, 0.0) - before_met[i].get(k, 0.0)
+                 for k in after_met[i]}
+        gin = sum(v for k, v in delta.items()
+                  if k.startswith("messages_in_total"))
+        gout = sum(v for k, v in delta.items()
+                   if k.startswith("messages_out_total"))
+        cpu = after_cpu[i] - before_cpu[i]
+        per_gw.append({
+            "driver": driver_out[i] if i < len(driver_out) else {},
+            "gateway_in_mps": round(gin / elapsed),
+            "gateway_out_mps": round(gout / elapsed),
+            "gateway_cpu_seconds": round(cpu, 2),
+            "gateway_cpu_utilization": round(cpu / elapsed, 3),
+            "cpu_us_per_msg": round(cpu / gin * 1e6, 2) if gin else None,
+        })
+
+    agg_in = sum(g["gateway_in_mps"] for g in per_gw)
+    agg_out = sum(g["gateway_out_mps"] for g in per_gw)
+    total_cpu = sum(g["gateway_cpu_seconds"] for g in per_gw)
+    total_in = sum(g["gateway_in_mps"] for g in per_gw) * elapsed
+    result = {
+        "metric": "gateway_edge",
+        "host_cores": os.cpu_count(),
+        "gateways": args.gateways,
+        "conns": args.conns,
+        "offered_mps": round(args.conns * args.rate),
+        "duration_s": round(elapsed, 1),
+        "aggregate_in_mps": agg_in,
+        "aggregate_routed_mps": agg_out,
+        "cpu_us_per_msg": round(total_cpu / total_in * 1e6, 2) if total_in
+        else None,
+        "mps_per_dedicated_core": round(1e6 / (total_cpu / total_in * 1e6))
+        if total_in and total_cpu else None,
+        "per_gateway": per_gw,
+        "note": "cpu_us_per_msg = gateway CPU per ingested message "
+                "(each is also routed+written out); mps_per_dedicated_core "
+                "= 1e6/cpu_us_per_msg, the per-core capacity this "
+                "measurement implies.",
+    }
+    line = json.dumps(result)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -11,7 +11,13 @@
 // steady-state sends at the configured per-connection rate.
 //
 //   load_client <host> <port> <conns> <rate_per_conn> <duration_s>
-//               [connect_stagger_us]
+//               [connect_stagger_us] [niceness] [mode]
+//
+// mode "load" (default): the flow above. mode "owner": one connection
+// that AUTHs, possesses GLOBAL via CREATE_CHANNEL, then drains and
+// frame-counts the forwarded traffic for the duration — the native
+// replacement for the Python owner_drain thread, which a saturated
+// single-core host starves into mismeasurement.
 //
 // Prints one JSON line: conns, authed, sent, frames_in, elapsed.
 #include <fcntl.h>
@@ -94,6 +100,10 @@ struct Conn {
       } else {
         size = (size_t(p[2]) << 8) | p[3];
       }
+      if (size == 0) {  // framing.py: zero-size frame is stream-fatal
+        ok = false;
+        break;
+      }
       if (rbuf.size() - pos < 5 + size) break;
       pos += 5 + size;
       frames_in++;
@@ -154,6 +164,8 @@ int main(int argc, char** argv) {
   // (single-core hosts: ~5-10; dedicated driver machine: 0).
   int niceness = argc > 7 ? atoi(argv[7]) : 5;
   if (niceness) setpriority(PRIO_PROCESS, 0, niceness);
+  bool owner_mode = argc > 8 && strcmp(argv[8], "owner") == 0;
+  if (owner_mode) n_conns = 1;
 
   addrinfo hints{}, *res = nullptr;
   hints.ai_family = AF_INET;
@@ -163,13 +175,22 @@ int main(int argc, char** argv) {
     return 1;
   }
 
-  std::string sub = Frame(
-      6, [] {  // SUB_TO_CHANNEL, write access, damped fan-out
-        chtpu::SubscribedToChannelMessage m;
-        m.mutable_suboptions()->set_dataaccess(chtpu::WRITE_ACCESS);
-        m.mutable_suboptions()->set_fanoutintervalms(2000);
-        return m.SerializeAsString();
-      }());
+  std::string sub;
+  if (owner_mode) {
+    // CREATE_CHANNEL with channelType=GLOBAL = possession
+    // (ref: message.go:336-340).
+    chtpu::CreateChannelMessage m;
+    m.set_channeltype(chtpu::GLOBAL);
+    sub = Frame(3, m.SerializeAsString());
+  } else {
+    sub = Frame(
+        6, [] {  // SUB_TO_CHANNEL, write access, damped fan-out
+          chtpu::SubscribedToChannelMessage m;
+          m.mutable_suboptions()->set_dataaccess(chtpu::WRITE_ACCESS);
+          m.mutable_suboptions()->set_fanoutintervalms(2000);
+          return m.SerializeAsString();
+        }());
+  }
   // Steady state: opaque user-space forward (msgType 100) — the
   // reference's headline routing scenario (bodies unparsed).
   std::string update = Frame(100, "\x08\x01\x12\x10pppppppppppppppp");
@@ -222,8 +243,11 @@ int main(int argc, char** argv) {
       Conn& c = conns[events[e].data.u32];
       ssize_t n = recv(c.fd, buf, sizeof(buf), MSG_DONTWAIT);
       if (n <= 0) {
-        if (n == 0) {
-          c.closed = true;
+        if (n == 0 && !c.closed) {  // EOF: tear down like the desync
+          c.closed = true;          // path so a half-closed socket can't
+          epoll_ctl(ep, EPOLL_CTL_DEL, c.fd, nullptr);  // keep waking us
+          close(c.fd);              // and double-decrementing live
+          c.fd = -1;
           live--;
         }
         continue;
@@ -253,7 +277,9 @@ int main(int argc, char** argv) {
   {
     int i = 0;
     for (auto& c : conns)
-      c.next_send = t0 + interval * (double(i++) / std::max(live, 1));
+      c.next_send = owner_mode
+                        ? t_end + 1e9  // owner only drains, never sends
+                        : t0 + interval * (double(i++) / std::max(live, 1));
   }
   while (true) {
     double now = MonoNow();
@@ -273,7 +299,12 @@ int main(int argc, char** argv) {
       Conn& c = conns[events[e].data.u32];
       ssize_t n = recv(c.fd, buf, sizeof(buf), MSG_DONTWAIT);
       if (n <= 0) {
-        if (n == 0) c.closed = true;
+        if (n == 0 && !c.closed) {  // EOF: same teardown as phase 2
+          c.closed = true;
+          epoll_ctl(ep, EPOLL_CTL_DEL, c.fd, nullptr);
+          close(c.fd);
+          c.fd = -1;
+        }
         continue;
       }
       c.rbuf.append(buf, size_t(n));

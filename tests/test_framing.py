@@ -168,3 +168,113 @@ def test_extended_size_rejects_tag_collision_hole():
     ext2 = FrameDecoder(extended_size=True)
     with _pytest.raises(FramingError):
         ext2.feed(frame2 + bytes(16))
+
+
+# ---- native ingest fast path (parse_forward) -----------------------------
+
+
+def _native_codec_or_skip():
+    try:
+        from channeld_tpu.native import codec
+    except ImportError:
+        pytest.skip("native codec not built")
+    if not hasattr(codec, "parse_forward"):
+        pytest.skip("native codec too old")
+    return codec
+
+
+def test_parse_forward_matches_protobuf_wrapping():
+    """Fast-path entries must be byte-identical to the protobuf path:
+    ServerForwardMessage{clientConnId, payload} serialized by upb."""
+    codec = _native_codec_or_skip()
+    from channeld_tpu.protocol import wire_pb2
+
+    p = wire_pb2.Packet()
+    payloads = [b"", b"x", b"p" * 300, bytes(range(256)) * 10]
+    for i, body in enumerate(payloads):
+        p.messages.add(channelId=0, msgType=100 + (i % 3), msgBody=body)
+    res = codec.parse_forward(p.SerializeToString(), 4242, 0, 100)
+    assert res is not None
+    entries, counts = res
+    assert len(entries) == len(payloads)
+    assert counts == {100: 2, 101: 1, 102: 1}
+    for (ch, bc, stub, mt, sfm), body in zip(entries, payloads):
+        assert (ch, bc, stub) == (0, 0, 0)
+        expect = wire_pb2.ServerForwardMessage(
+            clientConnId=4242, payload=body
+        ).SerializeToString()
+        assert sfm == expect
+        # And the decode side agrees.
+        rt = wire_pb2.ServerForwardMessage()
+        rt.ParseFromString(sfm)
+        assert rt.clientConnId == 4242 and rt.payload == body
+
+
+def test_parse_forward_zero_conn_id_and_empty_payload():
+    codec = _native_codec_or_skip()
+    from channeld_tpu.protocol import wire_pb2
+
+    p = wire_pb2.Packet()
+    p.messages.add(channelId=0, msgType=150)
+    (entries, counts) = codec.parse_forward(p.SerializeToString(), 0, 0, 100)
+    assert entries[0][4] == wire_pb2.ServerForwardMessage(
+        clientConnId=0, payload=b""
+    ).SerializeToString() == b""
+
+
+def test_parse_forward_rejects_non_fast_content():
+    """Anything that is not a plain user-space forward to the expected
+    channel must fall back to the full protobuf path (None)."""
+    codec = _native_codec_or_skip()
+    from channeld_tpu.protocol import wire_pb2
+
+    def pkt(**kw):
+        p = wire_pb2.Packet()
+        p.messages.add(**kw)
+        return p.SerializeToString()
+
+    cases = [
+        pkt(channelId=0, msgType=1, msgBody=b"auth"),      # system type
+        pkt(channelId=7, msgType=100, msgBody=b"x"),       # other channel
+        pkt(channelId=0, msgType=100, broadcast=1),        # broadcast set
+        pkt(channelId=0, msgType=100, stubId=9),           # rpc stub set
+        b"\x12\x03abc",                                    # unknown field
+        b"\x0a\xff\xff\xff\xff\xff",                       # truncated len
+    ]
+    for body in cases:
+        assert codec.parse_forward(body, 1, 0, 100) is None
+
+    # Mixed packet: one fast + one system message -> whole packet slow.
+    p = wire_pb2.Packet()
+    p.messages.add(channelId=0, msgType=100, msgBody=b"x")
+    p.messages.add(channelId=0, msgType=6, msgBody=b"sub")
+    assert codec.parse_forward(p.SerializeToString(), 1, 0, 100) is None
+
+
+def test_parse_forward_oversize_payload_falls_back():
+    codec = _native_codec_or_skip()
+    from channeld_tpu.protocol import wire_pb2
+
+    p = wire_pb2.Packet()
+    p.messages.add(channelId=0, msgType=100, msgBody=b"z" * 0xFFF0)
+    # Wrapping would overflow the 64KB outbound pack: slow path handles.
+    assert codec.parse_forward(p.SerializeToString(), 1, 0, 100) is None
+
+
+def test_parse_forward_overlong_varint_falls_back():
+    """msgType encoded as 2^32+5 is system message 5 to protobuf (uint32
+    truncation); the fast path must defer rather than classify it as
+    user-space."""
+    codec = _native_codec_or_skip()
+
+    def varint(v):
+        out = b""
+        while v >= 0x80:
+            out += bytes([(v & 0x7F) | 0x80])
+            v >>= 7
+        return out + bytes([v])
+
+    mt = (1 << 32) + 5
+    sub = b"\x20" + varint(mt) + b"\x2a\x01x"  # msgType=2^32+5, body "x"
+    body = b"\x0a" + varint(len(sub)) + sub
+    assert codec.parse_forward(body, 1, 0, 100) is None
