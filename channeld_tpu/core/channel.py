@@ -134,6 +134,8 @@ class Channel:
         self.device_sub_slots: dict[int, FanOutConnection] = {}
         self.device_fallback_focs: list[FanOutConnection] = []
         self.start_ns = time.monotonic_ns()
+        # connection.close_epoch at the last subscriber prune scan.
+        self._seen_close_epoch = -1
         st = global_settings.get_channel_settings(self.channel_type)
         self.tick_interval = st.tick_interval_ms / 1000.0
         self.tick_frames = 0
@@ -450,7 +452,13 @@ class Channel:
         self._tick_recoverable_subscriptions()
 
     def _tick_messages(self, tick_start: float) -> None:
-        """Drain the queue within the tick budget (ref: channel.go:389-412)."""
+        """Drain the queue within the tick budget (ref: channel.go:389-412).
+
+        The budget clock starts HERE, not at tick start: pre-message tick
+        work (spatial controller, ingest flush) must not eat the message
+        budget, or a full queue never drains below the congestion
+        watermark and paused reads stay paused (r5 10K-conn livelock)."""
+        tick_start = time.monotonic()
         try:
             queue = self.in_msg_queue
             while queue:
@@ -489,7 +497,17 @@ class Channel:
 
     def _tick_connections(self) -> None:
         """Prune closed subscribers; stash recoverable subs; handle owner
-        loss (ref: channel.go:414-475)."""
+        loss (ref: channel.go:414-475). Skipped entirely while no
+        connection anywhere has closed since this channel's last scan
+        (closes bump connection.close_epoch): the scan is idempotent and
+        a 10K-subscriber sweep at the tick rate was pure fixed cost."""
+        global _connection_mod
+        if _connection_mod is None:
+            from . import connection as _connection_mod
+        epoch = _connection_mod.close_epoch
+        if epoch == self._seen_close_epoch:
+            return
+        self._seen_close_epoch = epoch
         from .message import MessageContext
 
         for conn in list(self.subscribed_connections.keys()):

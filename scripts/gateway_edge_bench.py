@@ -132,10 +132,6 @@ def main() -> None:
             ))
         time.sleep(1.5)
 
-        before_cpu = [proc_cpu_seconds(gw["proc"].pid) for gw in gws]
-        before_met = [fetch_metrics(gw["mport"]) for gw in gws]
-        t0 = time.monotonic()
-
         per = args.conns // len(gws)
         for i, gw in enumerate(gws):
             n = per + (1 if i < args.conns % len(gws) else 0)
@@ -143,8 +139,18 @@ def main() -> None:
                 [BIN, "127.0.0.1", str(gw["ca"]), str(n), str(args.rate),
                  str(args.duration), str(args.connect_stagger_us),
                  str(args.driver_nice)],
-                stdout=subprocess.PIPE, text=True,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             ))
+        # The driver prints STEADY on stderr once every connection is
+        # authed: start the measurement window there so the connect/auth
+        # phase doesn't dilute per-message accounting.
+        for d in drivers:
+            line = d.stderr.readline()
+            if "STEADY" not in line:
+                raise RuntimeError(f"driver died before steady state: {line}")
+        before_cpu = [proc_cpu_seconds(gw["proc"].pid) for gw in gws]
+        before_met = [fetch_metrics(gw["mport"]) for gw in gws]
+        t0 = time.monotonic()
         driver_out = []
         for d in drivers:
             out, _ = d.communicate(timeout=args.duration + 240)
