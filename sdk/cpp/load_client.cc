@@ -203,7 +203,17 @@ int main(int argc, char** argv) {
     int fd = socket(AF_INET, SOCK_STREAM, 0);
     int one = 1;
     setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-    if (connect(fd, res->ai_addr, res->ai_addrlen) != 0) {
+    int rc = connect(fd, res->ai_addr, res->ai_addrlen);
+    // First connection retries (up to 30s): in compose the gateway may
+    // still be binding its listeners when this container starts.
+    for (int attempt = 0; i == 0 && rc != 0 && attempt < 30; attempt++) {
+      close(fd);
+      sleep(1);
+      fd = socket(AF_INET, SOCK_STREAM, 0);
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      rc = connect(fd, res->ai_addr, res->ai_addrlen);
+    }
+    if (rc != 0) {
       close(fd);
       connect_errors++;
       conns[i].closed = true;
