@@ -134,12 +134,21 @@ class GlobalSettings:
     tpu_mesh_hosts: int = 1
 
     def get_channel_settings(self, ct: ChannelType) -> ChannelSettings:
-        st = self.channel_settings.get(ct)
-        if st is None:
-            st = self.channel_settings.get(ChannelType.GLOBAL, ChannelSettings())
         # By-value copy, like the Go struct return — mutating the result
         # must not silently retune another channel type's settings.
+        st = self.channel_settings_view(ct)
         return replace(st, acl=replace(st.acl))
+
+    def channel_settings_view(self, ct: ChannelType) -> ChannelSettings:
+        """Read-only view (no defensive copy): for hot paths that only
+        READ settings — the copying form is two dataclasses.replace per
+        call, visible at handover-batch rates. Callers must not mutate."""
+        st = self.channel_settings.get(ct)
+        if st is None:
+            st = self.channel_settings.get(ChannelType.GLOBAL)
+            if st is None:
+                st = ChannelSettings()
+        return st
 
     def load_channel_settings(self, path: str) -> None:
         """Load the reference-schema channel settings JSON (keys = numeric type)."""

@@ -29,7 +29,7 @@ class ChannelSubscription:
 
 
 def default_sub_options(channel_type: int) -> control_pb2.ChannelSubscriptionOptions:
-    st = global_settings.get_channel_settings(ChannelType(channel_type))
+    st = global_settings.channel_settings_view(ChannelType(channel_type))
     return control_pb2.ChannelSubscriptionOptions(
         dataAccess=ChannelDataAccess.READ_ACCESS,
         dataFieldMasks=[],

@@ -398,13 +398,21 @@ class TPUSpatialController(StaticGrid2DSpatialController):
                     overflow, self.engine.undelivered_slots(result)[:8],
                 )
         self._publish_due(result)
-        for entity_id, src_cell, dst_cell in handovers:
-            self._run_handover(entity_id, src_cell, dst_cell)
+        if handovers:
+            # Batched orchestration: one owner-swap/remove-add/fan-out
+            # pass per (src,dst) cell pair, not per crossing — the device
+            # detects ~1.5K crossings per tick and per-crossing host
+            # orchestration measured 3.9x slower than the detection rate
+            # (scripts/bench_handover.py).
+            StaticGrid2DSpatialController.notify_crossings(
+                self,
+                (self._build_crossing(e, s, d) for e, s, d in handovers),
+            )
         if self._followers:
             self._apply_follow_interests(result)
 
-    def _run_handover(self, entity_id: int, src_cell: int, dst_cell: int) -> None:
-        """Run the host orchestration for one device-detected crossing."""
+    def _build_crossing(self, entity_id: int, src_cell: int, dst_cell: int):
+        """(old_info, new_info, provider) for one device-detected crossing."""
         provider = self._providers.get(entity_id)
         if provider is None:
             provider = lambda s, d: entity_id
@@ -425,6 +433,15 @@ class TPUSpatialController(StaticGrid2DSpatialController):
         if old_info is None:
             old_info = self._cell_center(src_cell)
         new_info = self._last_positions.get(entity_id) or self._cell_center(dst_cell)
+        return old_info, new_info, provider
+
+    def _run_handover(self, entity_id: int, src_cell: int, dst_cell: int) -> None:
+        """Run the host orchestration for one device-detected crossing
+        (kept for tests / tooling; the tick path batches via
+        notify_crossings)."""
+        old_info, new_info, provider = self._build_crossing(
+            entity_id, src_cell, dst_cell
+        )
         StaticGrid2DSpatialController.notify(self, old_info, new_info, provider)
 
     def _cell_center(self, cell: int) -> SpatialInfo:

@@ -310,12 +310,12 @@ def test_tpu_handover_uses_true_old_position():
     set_spatial_controller(ctl)
 
     seen = []
-    orig_notify = StaticGrid2DSpatialController.notify
+    orig_notify = StaticGrid2DSpatialController.notify_crossings
 
-    def spy(self, old_info, new_info, provider):
-        seen.append((old_info, new_info))
+    def spy(self, crossings):
+        seen.extend((old, new) for old, new, _p in crossings)
 
-    StaticGrid2DSpatialController.notify = spy
+    StaticGrid2DSpatialController.notify_crossings = spy
     try:
         eid = E + 6
         ctl.track_entity(eid, SpatialInfo(40.0, 0.0, 60.0))
@@ -329,7 +329,7 @@ def test_tpu_handover_uses_true_old_position():
         assert (old_info.x, old_info.z) == (40.0, 60.0)  # true, not (50, 50)
         assert (new_info.x, new_info.z) == (170.0, 30.0)
     finally:
-        StaticGrid2DSpatialController.notify = orig_notify
+        StaticGrid2DSpatialController.notify_crossings = orig_notify
 
 
 def test_stationary_entity_still_observed_by_device_controller():

@@ -351,3 +351,81 @@ def test_tpu_follow_interest_tracks_entity():
     run_ticks()   # tick 2: interest mask reflects the new center; subs diff
     run_ticks()
     assert set(player.spatial_subscriptions.keys()) == {START + 2}
+
+
+def _batchable_world(n_entities=6, lock_last=False):
+    """World + n entities on the cell-0/cell-1 border, pre-registered in
+    src data; returns (ctl, servers, entity_ids, crossings)."""
+    from channeld_tpu.spatial.grid import SpatialInfo
+
+    ctl, server_a, server_b = make_world()
+    src_ch = get_channel(START)
+    eids, crossings = [], []
+    for i in range(n_entities):
+        eid = ENTITY_START + 10 + i
+        entity_ch = create_entity_channel(eid, server_a)
+        entity_ch.init_data(entity_data(eid, 50, 50), None)
+        subscribe_to_channel(server_a, entity_ch, None)
+        src_ch.get_data_message().add_entity(eid, entity_ch.get_data_message())
+        eids.append(eid)
+        crossings.append(
+            (SpatialInfo(50, 0, 50), SpatialInfo(150, 0, 50),
+             lambda s, d, e=eid: e)
+        )
+    if lock_last:
+        ec = get_channel(eids[-1]).entity_controller
+        ec.add_to_group(EntityGroupType.HANDOVER, [eids[-1]])
+        ec.add_to_group(EntityGroupType.LOCK, [eids[-1]])
+    return ctl, (server_a, server_b), eids, crossings
+
+
+def _world_state(eids, servers):
+    src_ch, dst_ch = get_channel(START), get_channel(START + 1)
+    return {
+        "src_entities": sorted(src_ch.get_data_message().entities),
+        "dst_entities": sorted(dst_ch.get_data_message().entities),
+        "owners": [get_channel(e).get_owner().id for e in eids
+                   if get_channel(e).get_owner() is not None],
+        "b_subbed": sorted(
+            e for e in eids
+            if get_channel(e).subscribed_connections.get(servers[1])),
+        "msgs": [
+            sorted((ctx.msg_type, ctx.msg.srcChannelId, ctx.msg.dstChannelId)
+                   for ctx in s.sent
+                   if ctx.msg_type == MessageType.CHANNEL_DATA_HANDOVER)
+            for s in servers
+        ],
+    }
+
+
+def test_batched_crossings_match_sequential_notify():
+    """notify_crossings (the TPU tick path) must produce the same world
+    state as N sequential notify() calls: same data moves, owner swaps,
+    auto-subscriptions, and lock-beats-handover — with the per-pair
+    fan-out coalesced into one message per recipient."""
+    # Sequential reference run.
+    ctl, servers, eids, crossings = _batchable_world(lock_last=True)
+    for old, new, provider in crossings:
+        ctl.notify(old, new, provider)
+    get_channel(START).tick_once(0)
+    get_channel(START + 1).tick_once(0)
+    seq = _world_state(eids, servers)
+
+    # Batched run on a fresh world.
+    fresh_runtime()
+    register_sim_types()
+    ctl, servers, eids, crossings = _batchable_world(lock_last=True)
+    ctl.notify_crossings(crossings)
+    get_channel(START).tick_once(0)
+    get_channel(START + 1).tick_once(0)
+    bat = _world_state(eids, servers)
+
+    # Locked entity stayed put in both runs.
+    assert eids[-1] in seq["src_entities"] and eids[-1] in bat["src_entities"]
+    for key in ("src_entities", "dst_entities", "owners", "b_subbed"):
+        assert bat[key] == seq[key], key
+    # Fan-out coalesces: sequential sends one handover per crossing,
+    # batched one per (src,dst) pair per recipient — same pair ids.
+    assert {m for per in bat["msgs"] for m in per} == \
+        {m for per in seq["msgs"] for m in per}
+    assert all(len(per) == 1 for per in bat["msgs"])
