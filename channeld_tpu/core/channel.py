@@ -20,6 +20,7 @@ from dataclasses import dataclass
 from enum import IntEnum
 from typing import Callable, Optional
 
+from ..chaos.injector import chaos as _chaos
 from ..protocol import control_pb2
 from ..utils.idalloc import IdAllocator
 from ..utils.logger import get_logger
@@ -319,7 +320,13 @@ class Channel:
         a reserve above the cap: they are control-plane, self-limited, and
         dropping them would corrupt channel state."""
         size = len(self.in_msg_queue)
-        if external and size >= QUEUE_CAPACITY:
+        if external and (
+            size >= QUEUE_CAPACITY
+            # Chaos: report the queue full without it being full — the
+            # caller must take the same stash-don't-drop path it would
+            # under a real overload (lifted when the next tick drains).
+            or (_chaos.armed and _chaos.fire("connection.queue_full"))
+        ):
             self._mark_congested(qm)
             return False
         self.in_msg_queue.append(qm)
@@ -475,6 +482,13 @@ class Channel:
                         getattr(qm.ctx, "msg_type", None),
                     )
                     continue
+                if _chaos.armed:
+                    # Chaos: a slow handler eats the tick budget; the
+                    # budget break below must defer the tail (and the
+                    # backpressure lift in finally must still run).
+                    stall = _chaos.stall_s("channel.tick_budget")
+                    if stall:
+                        time.sleep(stall)
                 if qm.ctx is None:
                     continue
                 if (

@@ -39,12 +39,27 @@ from .types import (
 
 logger = get_logger("connection")
 
-# Hot-path handles resolved lazily by receive_message (circular imports
-# prevent binding them at module import time).
+# Hot-path handles resolved lazily by _bind_hot_handles (circular
+# imports prevent binding them at module import time).
 _get_channel = None
 _MESSAGE_MAP = None
 _handle_c2s_user = None
 _handle_s2c_user = None
+
+
+def _bind_hot_handles() -> None:
+    """One-time late binding (circular-import-safe); the previous
+    per-call ``from .channel import ...`` form ran the import machinery
+    ~650K times in a 27s load profile."""
+    global _get_channel, _MESSAGE_MAP, _handle_c2s_user, _handle_s2c_user
+    from .channel import get_channel as _gc
+    from .message import (
+        MESSAGE_MAP as _mm,
+        handle_client_to_server_user_message as _c2s,
+        handle_server_to_client_user_message as _s2c,
+    )
+    _get_channel, _MESSAGE_MAP = _gc, _mm
+    _handle_c2s_user, _handle_s2c_user = _c2s, _s2c
 
 
 class _ForwardBatch:
@@ -222,6 +237,9 @@ class Connection:
             and not recording
             and self.connection_type == ConnectionType.CLIENT
         )
+        if fast_eligible and _MESSAGE_MAP is None:
+            _bind_hot_handles()
+        MESSAGE_MAP = _MESSAGE_MAP
         receive_message = self.receive_message
         pending_msgs = self._pending_msgs
         self._m_packet_received.inc(len(bodies))
@@ -231,9 +249,15 @@ class Connection:
             for body in bodies:
                 if fast_eligible:
                     res = parse_forward(body, conn_id, 0, 100)
+                    # Registered user-space handlers (MSG_SPAWN=103 etc.,
+                    # models/engine_adapter.py) take precedence over the
+                    # raw-forward route, exactly like the slow path's
+                    # MESSAGE_MAP dispatch — a batch containing any
+                    # registered type goes through protobuf (advisor r5
+                    # high: mis-routing them skipped spawn registration).
                     if res is not None and (
                         fsm is None or fsm.user_space_fast(res[1])
-                    ):
+                    ) and not any(mt in MESSAGE_MAP for mt in res[1]):
                         if pending_msgs:
                             # Congested: stash the parsed batch behind the
                             # existing backlog (same ordering the slow
@@ -328,6 +352,15 @@ class Connection:
     def has_pending(self) -> bool:
         return bool(self._pending_msgs)
 
+    def pending_head_channel(self) -> Optional[int]:
+        """Channel id the head of the pending stash targets (what a
+        failing flush_pending is blocked on); None with nothing stashed.
+        Forward batches always target GLOBAL (0)."""
+        if not self._pending_msgs:
+            return None
+        mp, _ = self._pending_msgs[0]
+        return 0 if type(mp) is _ForwardBatch else mp.channelId
+
     def flush_pending(self) -> bool:
         """Re-dispatch stashed messages in order; True when drained.
         Stops (False) at the first message whose channel queue is still
@@ -354,28 +387,32 @@ class Connection:
         the pack and retry once backpressure drains
         (ref: connection.go:547-615; the reference's blocking queue send
         maps to the stash + paused reads)."""
-        global _get_channel, _MESSAGE_MAP, _handle_c2s_user, _handle_s2c_user
         if _get_channel is None:
-            # One-time late binding (circular-import-safe); the previous
-            # per-call ``from .channel import ...`` form ran the import
-            # machinery ~650K times in a 27s load profile.
-            from .channel import get_channel as _gc
-            from .message import (
-                MESSAGE_MAP as _mm,
-                handle_client_to_server_user_message as _c2s,
-                handle_server_to_client_user_message as _s2c,
-            )
-            _get_channel, _MESSAGE_MAP = _gc, _mm
-            _handle_c2s_user, _handle_s2c_user = _c2s, _s2c
+            _bind_hot_handles()
         get_channel = _get_channel
         MESSAGE_MAP = _MESSAGE_MAP
         handle_client_to_server_user_message = _handle_c2s_user
         handle_server_to_client_user_message = _handle_s2c_user
 
         if type(mp) is _ForwardBatch:
-            # Batched ingest run: FSM verdicts were checked at parse time
-            # (user_space_fast: allowed, no transitions), so only the
-            # channel hop and metrics attribution remain.
+            # Re-take the FSM verdict at dispatch time (advisor r5 low):
+            # a batch stashed behind pending messages can be dispatched
+            # after those messages transitioned the FSM, making the
+            # parse-time verdict stale — the slow path evaluates
+            # is_allowed at dispatch, so this path must too.
+            if self.fsm is not None and not self.fsm.user_space_fast(mp.counts):
+                for mt, n in mp.counts.items():
+                    for _ in range(n):
+                        events.fsm_disallowed.broadcast(
+                            events.FsmDisallowedData(
+                                connection=self, msg_type=mt
+                            )
+                        )
+                self.logger.warning(
+                    "batched forward rejected by FSM in state %s",
+                    self.fsm.current.name,
+                )
+                return False
             channel = get_channel(0)
             if channel is None:
                 return False
@@ -562,6 +599,38 @@ class Connection:
         abnormal close, enabling recovery for recoverable server conns."""
         if self.is_closing():
             return
+        # Deliver a still-deferred ingest run BEFORE teardown (advisor r5
+        # medium): a client's final user-space burst can land in the same
+        # event-loop batch as EOF (data_received then connection_lost
+        # before the 1ms pump) — the previous synchronous dispatch and
+        # the reference's sequential read loop both delivered it.
+        if self._fast_run is not None:
+            try:
+                self.flush_ingest()
+            except Exception:
+                self.logger.exception("final ingest flush failed during close")
+        if self._pending_msgs:
+            # A congested stash gets one last dispatch attempt; whatever
+            # the full channel still refuses dies with the conn — but
+            # COUNTED (packet_dropped), never silently (the flush_ingest
+            # above can also land here when the queue is full).
+            try:
+                self.flush_pending()
+            except Exception:
+                self.logger.exception("final stash flush failed during close")
+            if self._pending_msgs:
+                dropped = 0
+                counted = set()
+                for mp, drop_token in self._pending_msgs:
+                    if drop_token[0] or id(drop_token) in counted:
+                        continue
+                    counted.add(id(drop_token))
+                    drop_token[0] = True
+                    dropped += (mp.n_packets if type(mp) is _ForwardBatch
+                                else 1)
+                if dropped:
+                    self._m_packet_dropped.inc(dropped)
+                self._pending_msgs.clear()
         if self._is_packet_recording_enabled() and self.replay_session is not None:
             self.replay_session.persist(
                 global_settings.replay_session_persistence_dir, self.id
@@ -587,7 +656,11 @@ class Connection:
         except Exception:
             pass
         self.send_queue.clear()
-        self._fast_run = None  # in-flight inbound dies with the conn
+        # Normally already flushed above; a run that re-appeared (close
+        # handler fed bytes) dies with the conn.
+        self._fast_run = None
+        _pending_ingest.discard(self)
+        _stash_retry.pop(self, None)
         _all_connections.pop(self.id, None)
         from .ddos import untrack_unauthenticated
 
@@ -773,24 +846,34 @@ def drain_pending_flush() -> set["Connection"]:
 # Connections whose ingest dispatch stashed (queue full) from a pump- or
 # tick-time flush, where no transport drain task exists to retry: the
 # pump retries flush_pending until the stash drains (the transport-side
-# _drain task covers the read-triggered case).
-_stash_retry: set["Connection"] = set()
+# _drain task covers the read-triggered case). A dict, not a set, so
+# retries run in stash order (FIFO fairness, and deterministic tests).
+_stash_retry: dict["Connection", None] = {}
 
 
 def flush_pending_ingest() -> None:
     """Dispatch every deferred ingest run (1ms pump and channel ticks)."""
     global _pending_ingest
     if _stash_retry:
+        # Channels observed full this cycle: conns whose stash head
+        # targets one are skipped without re-attempting (a 10K-conn
+        # backlog must not eat the tick budget re-failing), but conns
+        # blocked on a DIFFERENT, drained channel still flush now
+        # (advisor r5 low: the old break delayed them a full cycle).
+        full_channels: set[int] = set()
         for conn in list(_stash_retry):
             if conn.is_closing():
-                _stash_retry.discard(conn)
-            elif conn.flush_pending():
-                _stash_retry.discard(conn)
+                _stash_retry.pop(conn, None)
+                continue
+            head = conn.pending_head_channel()
+            if head is not None and head in full_channels:
+                continue  # known-full target; retry next cycle
+            if conn.flush_pending():
+                _stash_retry.pop(conn, None)
             else:
-                # Target queue still full: every later conn would fail
-                # the same way — stop so a 10K-conn stash backlog can't
-                # eat the tick budget re-failing (next cycle continues).
-                break
+                blocked = conn.pending_head_channel()
+                if blocked is not None:
+                    full_channels.add(blocked)
     if not _pending_ingest:
         return
     pending, _pending_ingest = _pending_ingest, set()
@@ -798,7 +881,7 @@ def flush_pending_ingest() -> None:
         if not conn.is_closing():
             conn.flush_ingest()
             if conn.has_pending():
-                _stash_retry.add(conn)
+                _stash_retry[conn] = None
 
 
 def flush_all() -> None:

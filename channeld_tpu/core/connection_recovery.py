@@ -58,6 +58,14 @@ class RecoverableSubscription:
 # PIT -> handle (ref: connectionRecoverHandles map).
 _recover_handles: dict[str, ConnectionRecoverHandle] = {}
 
+# Hard cap on outstanding handles. With server_conn_recover_timeout_ms=0
+# handles never time out, so a fleet of crashed-and-replaced servers
+# (each with a fresh PIT) would grow the table forever — chaos soaks
+# with repeated transport resets surfaced exactly this. At the cap the
+# oldest-disconnected handle is evicted: its server has had the longest
+# window to return, and an evicted PIT simply re-joins without recovery.
+MAX_RECOVER_HANDLES = 4096
+
 
 def get_recover_handle(pit: str) -> Optional[ConnectionRecoverHandle]:
     return _recover_handles.get(pit)
@@ -65,11 +73,52 @@ def get_recover_handle(pit: str) -> Optional[ConnectionRecoverHandle]:
 
 def make_recoverable(conn: "Connection") -> None:
     """(ref: connection_recovery.go:34-41)."""
+    if (
+        conn.pit not in _recover_handles
+        and len(_recover_handles) >= MAX_RECOVER_HANDLES
+    ):
+        from . import metrics
+
+        # Never evict an in-progress recovery (new_conn set): the reaper
+        # only scans this table, so an evicted in-progress handle would
+        # never get RECOVERY_END and its connection would stay in
+        # recovery forever. Idle handles (server not back yet) are safe
+        # to drop — the server simply re-joins without recovery. With no
+        # idle handle to evict (every slot mid-recovery — a mass-restart
+        # burst), the safe degradation is to make THIS close
+        # non-recoverable rather than wedge a recovering peer.
+        idle = [p for p, h in _recover_handles.items() if h.new_conn is None]
+        if not idle:
+            logger.warning(
+                "recovery handle table full (%d) with every handle "
+                "mid-recovery; %s will re-join without recovery",
+                MAX_RECOVER_HANDLES, conn.pit,
+            )
+            return
+        oldest = min(idle, key=lambda p: _recover_handles[p].disconn_time)
+        del _recover_handles[oldest]
+        _purge_recoverable_subs(oldest)
+        metrics.recover_handles_evicted.inc()
+        logger.warning(
+            "recovery handle table full (%d); evicted oldest idle pit %s",
+            MAX_RECOVER_HANDLES, oldest,
+        )
     handle = ConnectionRecoverHandle(
         prev_conn_id=conn.id, disconn_time=time.monotonic()
     )
     _recover_handles[conn.pit] = handle
     conn.recover_handle = handle
+
+
+def _purge_recoverable_subs(pit: str) -> None:
+    """Drop a PIT's stashed per-channel recovery state. Without this, an
+    evicted (or timed-out-with-timeout-0: never) handle would leave a
+    RecoverableSubscription in every channel the server subscribed to —
+    the crash-loop leak the handle cap exists to stop lives there too."""
+    from .channel import all_channels
+
+    for ch in all_channels().values():
+        ch.recoverable_subs.pop(pit, None)
 
 
 def recover_from_handle(conn: "Connection", handle: ConnectionRecoverHandle) -> None:
@@ -88,6 +137,9 @@ def recover_from_handle(conn: "Connection", handle: ConnectionRecoverHandle) -> 
     conn.recover_handle = handle
     handle.new_conn = conn
     handle.start_recovery_time = time.monotonic()
+    from . import metrics
+
+    metrics.connection_recovered.inc()
 
 
 def tick_connection_recovery_once() -> None:

@@ -45,6 +45,7 @@ import time
 from collections import deque
 from typing import Callable, Iterator, Optional
 
+from ..chaos.injector import chaos as _chaos
 from ..utils.logger import get_logger
 
 logger = get_logger("kcp")
@@ -138,6 +139,7 @@ class KcpConn:
         self.closed = False
         self.shed = False
         self.paused = False  # receiver backpressure: hold delivery
+        self._chaos_held: list[bytes] = []  # reorder-fault holding pen
         self.on_stream: Optional[Callable[[bytes], None]] = None
         self.on_close: Optional[Callable[[], None]] = None
 
@@ -234,11 +236,30 @@ class KcpConn:
         buf = bytearray()
         for seg in segments:
             if buf and len(buf) + len(seg) > MTU:
-                self._output(bytes(buf))
+                self._send_datagram(bytes(buf))
                 buf.clear()
             buf.extend(seg)
         if buf:
-            self._output(bytes(buf))
+            self._send_datagram(bytes(buf))
+
+    def _send_datagram(self, datagram: bytes) -> None:
+        """Datagram egress, with the chaos loss/reorder/dup gate in front
+        — the faults the ARQ exists to absorb. A held (reordered)
+        datagram flushes after the next one; if traffic stops, the RTO
+        retransmission regenerates it, so holding is equivalent to loss."""
+        if _chaos.armed:
+            if _chaos.fire("kcp.loss"):
+                return
+            if _chaos.fire("kcp.dup"):
+                self._output(datagram)
+            if _chaos.fire("kcp.reorder"):
+                self._chaos_held.append(datagram)
+                return
+        self._output(datagram)
+        if self._chaos_held:
+            held, self._chaos_held = self._chaos_held, []
+            for h in held:
+                self._output(h)
 
     # -- receiving --------------------------------------------------------
 

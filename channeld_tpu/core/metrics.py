@@ -79,6 +79,13 @@ tpu_cell_overflow = Gauge(
     "(re-offered next tick)",
     registry=registry,
 )
+tpu_cell_overflow_total = Counter(
+    "tpu_cell_overflow_entities",
+    "Cumulative entities whose cells-plane redistribution bucket was full "
+    "(each was re-offered the next tick; the gauge above is the last-tick "
+    "snapshot, this counter is the soak-visible total)",
+    registry=registry,
+)
 tpu_capacity_shed = Counter(
     "tpu_capacity_shed",
     "Device-plane registrations shed to the host path at capacity",
@@ -90,6 +97,31 @@ handover_count = Counter(
     "Cross-cell entity handovers orchestrated",
     registry=registry,
 )
+# Robustness plane (chaos + recovery + sidecar hardening).
+chaos_faults = Counter(
+    "chaos_faults",
+    "Faults injected by the chaos layer (only moves while a scenario is "
+    "armed; see channeld_tpu.chaos)",
+    ["point"],
+    registry=registry,
+)
+connection_recovered = Counter(
+    "connection_recovered",
+    "Recoverable server connections that reclaimed their previous id",
+    registry=registry,
+)
+recover_handles_evicted = Counter(
+    "recover_handles_evicted",
+    "Recovery handles evicted at the table cap (oldest-first)",
+    registry=registry,
+)
+sidecar_call_retries = Counter(
+    "sidecar_call_retries",
+    "gRPC sidecar calls retried after a transient failure",
+    ["method"],
+    registry=registry,
+)
+
 # The goroutine-count analog: live asyncio tasks (one per channel tick,
 # listener, pump). Updated by the server's heartbeat (serve loops) and by
 # any caller of sample_runtime().

@@ -19,6 +19,7 @@ import time
 from collections import deque
 from typing import Optional
 
+from ..chaos.injector import chaos as _chaos
 from ..utils.logger import get_logger, init_logs
 from . import events
 from .channel import congestion_wait, connection_congested, init_channels
@@ -113,7 +114,25 @@ class _TcpServerProtocol(asyncio.Protocol):
         conn = self.conn
         if conn is None:
             return
+        # Transport/connection faults target CLIENT sockets: the chaos
+        # story is "the gateway degrades gracefully under hostile client
+        # weather"; server-plane loss is exercised by the C19 recovery
+        # scenarios instead.
+        inject = _chaos.armed and self.conn_type == ConnectionType.CLIENT
+        if inject:
+            data = self._chaos_ingress(data)
+            if data is None:
+                return
         conn.on_bytes(data)
+        if inject and not conn.is_closing() and _chaos.fire(
+            "connection.eof_race"
+        ):
+            # The peer vanishes right after this read: EOF races any
+            # deferred ingest batch — close() must deliver the final
+            # burst before teardown (pinned by test_chaos).
+            self.transport.close()
+            conn.close(unexpected=True)
+            return
         if conn.is_closing():
             self.transport.close()
             return
@@ -127,6 +146,31 @@ class _TcpServerProtocol(asyncio.Protocol):
             if not self._draining:
                 self._draining = True
                 asyncio.ensure_future(self._drain())
+
+    def _chaos_ingress(self, data: bytes):
+        """Armed-only transport fault gate: None = read consumed by the
+        fault (socket reset), else the (possibly corrupted) bytes."""
+        conn = self.conn
+        if _chaos.fire("transport.reset"):
+            # Peer reset before the read was processed: bytes lost, the
+            # connection takes the unexpected-close path (recovery
+            # eligibility, metrics, channel prune).
+            self.transport.abort()
+            conn.close(unexpected=True)
+            return None
+        if _chaos.fire("transport.truncate"):
+            # Peer died mid-frame: a prefix arrives, then the reset. The
+            # decoder must hold the partial frame without corrupting
+            # state, and teardown must not double-count.
+            conn.on_bytes(bytes(data[: max(1, len(data) // 2)]))
+            self.transport.abort()
+            conn.close(unexpected=True)
+            return None
+        if _chaos.fire("transport.corrupt"):
+            # One flipped byte: framing/protobuf violations are
+            # connection-fatal (never silently misparsed).
+            data = bytes([data[0] ^ 0xFF]) + data[1:]
+        return data
 
     async def _drain(self) -> None:
         conn = self.conn
@@ -418,6 +462,14 @@ async def run_server(argv: Optional[list[str]] = None) -> None:
         from .profiling import start_profiling
 
         start_profiling(global_settings.profile, global_settings.profile_path)
+    if global_settings.chaos_config:
+        from ..chaos import arm_from_file
+
+        arm_from_file(global_settings.chaos_config)
+        logger.warning(
+            "CHAOS ARMED from %s — deterministic fault injection is live",
+            global_settings.chaos_config,
+        )
     init_connections(global_settings.server_fsm, global_settings.client_fsm)
     init_channels()
     init_anti_ddos()

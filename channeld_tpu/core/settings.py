@@ -125,6 +125,10 @@ class GlobalSettings:
     spatial_backend: str = "host"  # "host" | "tpu"
     tpu_entity_capacity: int = 1 << 17
     tpu_query_capacity: int = 1 << 12
+    # Chaos fault-injection scenario JSON (new — see doc/chaos.md).
+    # Empty = the injector stays disarmed and every hook is a no-op.
+    chaos_config: str = ""
+
     # Device mesh for the spatial engine: 0 devices = single-device step;
     # N>0 shards the entity arrays over the first N jax devices, and
     # hosts>1 arranges them as a (hosts, chips) DCN x ICI mesh — the TPU
@@ -218,6 +222,9 @@ class GlobalSettings:
         p.add_argument("-spatial-backend", type=str, default=self.spatial_backend,
                        choices=("host", "tpu"),
                        help="where the AOI/fan-out decision pass runs")
+        p.add_argument("-chaos", type=str, default="",
+                       help="chaos scenario JSON path; arms deterministic "
+                            "fault injection (doc/chaos.md)")
         p.add_argument("-mesh-devices", type=int, default=self.tpu_mesh_devices,
                        help="shard the spatial engine over N devices "
                             "(0 = single-device step)")
@@ -254,6 +261,7 @@ class GlobalSettings:
         self.connection_auth_timeout_ms = args.cat
         self.max_failed_auth_attempts = args.mfaa
         self.max_fsm_disallowed = args.mfd
+        self.chaos_config = args.chaos
         self.spatial_backend = args.spatial_backend
         self.tpu_mesh_devices = args.mesh_devices
         self.tpu_mesh_hosts = args.mesh_hosts

@@ -368,13 +368,38 @@ def create_server(port: int = 50051, max_workers: int = 4,
 
 class SpatialDecisionClient:
     """Typed client for gateways written in Python (external gateways use
-    the proto schema directly)."""
+    the proto schema directly).
+
+    Unary calls are hardened for the gateway tick loop: every call
+    carries a deadline (a hung sidecar must never wedge the tick
+    forever), and transient failures retry with deterministic
+    exponential backoff before surfacing. Retryable codes are
+    per-method: Configure is idempotent, so a timed-out call retries
+    safely; Step is NOT retried on DEADLINE_EXCEEDED — a step that
+    executed server-side but whose response timed out has already
+    drained this caller's dirty set and allocated any requested
+    subscription slots, so replaying it would lose delta-interest
+    updates and leak slots. StepStream is not retried at all: a broken
+    stream loses its per-caller delta state, so the caller must reopen
+    and accept the automatic full resync."""
+
+    # grpc codes considered transient per method; resolved lazily
+    # (grpc import).
+    _RETRYABLE = {
+        "Configure": ("UNAVAILABLE", "DEADLINE_EXCEEDED"),
+        "Step": ("UNAVAILABLE",),  # non-idempotent: see class docstring
+    }
 
     def __init__(self, target: str = "127.0.0.1:50051",
-                 auth_token: Optional[str] = None):
+                 auth_token: Optional[str] = None,
+                 timeout_s: float = 5.0, max_retries: int = 3,
+                 backoff_s: float = 0.1):
         import grpc
 
         self.target = target
+        self.timeout_s = timeout_s
+        self.max_retries = max_retries
+        self.backoff_s = backoff_s
         self._channel = grpc.insecure_channel(target)
         meta = [(CALLER_METADATA_KEY, uuid.uuid4().hex)]
         if auth_token:
@@ -396,11 +421,49 @@ class SpatialDecisionClient:
             response_deserializer=StepResponse.FromString,
         )
 
+    def _call_with_retry(self, method_name: str, fn, request):
+        """Deadline + deterministic exponential backoff on transient
+        codes. Deterministic (no jitter) on purpose: chaos replays must
+        see the same retry schedule."""
+        import grpc
+
+        retryable = tuple(
+            getattr(grpc.StatusCode, c)
+            for c in self._RETRYABLE.get(method_name, ())
+        )
+        delay = self.backoff_s
+        attempt = 0
+        while True:
+            try:
+                return fn(request, metadata=self._metadata,
+                          timeout=self.timeout_s)
+            except grpc.RpcError as e:
+                code = e.code() if hasattr(e, "code") else None
+                if code not in retryable or attempt >= self.max_retries:
+                    raise
+                attempt += 1
+                try:
+                    from ..core import metrics
+
+                    metrics.sidecar_call_retries.labels(
+                        method=method_name
+                    ).inc()
+                except Exception:
+                    pass
+                logger.warning(
+                    "sidecar %s transient failure (%s); retry %d/%d in %.2fs",
+                    method_name, code, attempt, self.max_retries, delay,
+                )
+                time.sleep(delay)
+                delay *= 2
+
     def configure(self, **kwargs) -> None:
-        self._configure(ConfigRequest(**kwargs), metadata=self._metadata)
+        self._call_with_retry(
+            "Configure", self._configure, ConfigRequest(**kwargs)
+        )
 
     def step(self, request: StepRequest) -> StepResponse:
-        return self._step(request, metadata=self._metadata)
+        return self._call_with_retry("Step", self._step, request)
 
     def step_stream(self, request_iterator):
         """Returns the response iterator for a bidirectional pipeline."""

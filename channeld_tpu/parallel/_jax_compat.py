@@ -1,0 +1,36 @@
+"""jax version compatibility shims for the sharded decision planes.
+
+The image may carry jax 0.4.x (no top-level ``shard_map``, ``check_rep``
+instead of ``check_vma``, no ``jax.lax.axis_size``) or >= 0.5. Both
+mesh.py and spatial_alltoall.py import from here so the version sniffing
+lives — and gets fixed — in exactly one place.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:  # jax >= 0.5 exports shard_map at top level
+    from jax import shard_map
+except ImportError:  # jax 0.4.x: experimental namespace; check_vma was
+    # named check_rep there (same meaning: replication checking off).
+    from functools import wraps as _wraps
+
+    from jax.experimental.shard_map import shard_map as _shard_map_04
+
+    @_wraps(_shard_map_04)
+    def shard_map(*args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map_04(*args, **kwargs)
+
+
+if not hasattr(jax.lax, "axis_size"):
+    # jax 0.4.x: psum of ones over the axis is the canonical size idiom
+    # (constant-folded under shard_map, so it costs no collective).
+    def axis_size(axis_name):
+        return jax.lax.psum(1, axis_name)
+else:
+    axis_size = jax.lax.axis_size
+
+__all__ = ["axis_size", "shard_map"]

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 from typing import Callable, Optional
 
+from ..chaos.injector import chaos as _chaos
 from ..core.settings import global_settings
 from ..utils.logger import get_logger
 from .controller import SpatialInfo, register_spatial_controller_type
@@ -378,6 +379,14 @@ class TPUSpatialController(StaticGrid2DSpatialController):
         import time as _time
 
         t0 = _time.monotonic()
+        if _chaos.armed:
+            # Chaos: a slow device dispatch (compilation hiccup, busy
+            # chip, thermal step-down). The tick must absorb it —
+            # degradation shows in tpu_step_latency / tick p99, never as
+            # an exception into the channel tick.
+            stall = _chaos.stall_s("device.dispatch_stall")
+            if stall:
+                _time.sleep(stall)
         result = self.engine.tick()
         handovers = self.engine.handover_list(result)
         metrics.tpu_step_latency.observe(_time.monotonic() - t0)
@@ -388,6 +397,10 @@ class TPUSpatialController(StaticGrid2DSpatialController):
             # the shed so a sustained overflow is operator-visible.
             overflow = self.engine.last_overflow
             metrics.tpu_cell_overflow.set(overflow)
+            if overflow:
+                # Cumulative counter so a soak can assert the shed path
+                # actually fired even when the final tick was clean.
+                metrics.tpu_cell_overflow_total.inc(overflow)
             if overflow and _time.monotonic() - self._overflow_logged >= 5.0:
                 self._overflow_logged = _time.monotonic()
                 from ..utils.logger import security_logger

@@ -25,6 +25,13 @@ if os.environ.get("CHTPU_TEST_TPU") != "1":
 
 import pytest  # noqa: E402
 
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running soaks/benches excluded from tier-1 (-m 'not slow')",
+    )
+
 # Build the native codec once if a toolchain exists, so the native-path
 # parity tests run instead of skipping (they skip gracefully if this
 # fails — e.g. no g++). Cheap (~5s) and idempotent.

@@ -370,8 +370,13 @@ def _fill_queue_then_assert_stash(conn, gch, channel_mod):
     gch.execute(lambda ch: None)
     assert gch.in_msg_queue.qsize() == channel_mod.QUEUE_CAPACITY + 1
 
-    # Drain the tick; flush_pending re-dispatches the stash in order.
-    gch.tick_once()
+    # Drain the ticks (a slow box may hit the tick budget and defer a
+    # tail to the next tick); flush_pending re-dispatches the stash in
+    # order once the queue is empty.
+    for _ in range(100):
+        if gch.in_msg_queue.qsize() == 0:
+            break
+        gch.tick_once()
     assert gch.in_msg_queue.qsize() == 0
     assert conn.flush_pending()
     assert not conn.has_pending()
@@ -418,7 +423,12 @@ def test_fsm_transition_deferred_until_enqueue_succeeds():
     assert conn.has_pending()
     assert conn.fsm.current.name == "OPEN"  # NOT advanced on the failure
 
-    gch.tick_once()
+    # Drain the ticks (a slow box may hit the tick budget and defer a
+    # tail to the next tick) before the stash retries.
+    for _ in range(100):
+        if gch.in_msg_queue.qsize() == 0:
+            break
+        gch.tick_once()
     assert conn.flush_pending()
     assert gch.in_msg_queue.qsize() == 1  # the retried message enqueued
     assert conn.fsm.current.name == "LOCKED"  # transition fired exactly once
@@ -622,3 +632,181 @@ def test_pump_retries_stashed_batch_without_transport_drain():
     owner.flush()
     fwd = [m for m in sent_messages(ot) if m.msgType >= 100]
     assert len(fwd) == 1  # delivered without the client sending again
+
+
+# ---- round-5 advisor regressions ------------------------------------------
+
+
+def test_fast_path_defers_to_registered_user_handlers():
+    """Advisor r5 high: a client msgType with a registered user-space
+    handler (MSG_SPAWN=103 style) must take the MESSAGE_MAP dispatch, not
+    the raw-forward fast path — mis-routing it skips spawn registration."""
+    if connection_mod._native_codec is None:
+        pytest.skip("native codec not built")
+    from channeld_tpu.core.message import register_message_handler
+
+    owner, ot = _owner_with_global()
+    conn, _ = auth_client()
+    ot.written.clear()
+
+    handled = []
+    register_message_handler(
+        103, wire_pb2.ServerForwardMessage,
+        lambda ctx: handled.append(ctx.msg_type),
+    )
+
+    # One packet: a plain forward (100) and the registered type (103).
+    conn.on_bytes(_forward_wire([b"plain"], msg_type=100))
+    conn.on_bytes(_forward_wire([wire_pb2.ServerForwardMessage(
+        clientConnId=conn.id).SerializeToString()], msg_type=103))
+    gch = get_global_channel()
+    gch.tick_once(0)
+    owner.flush()
+
+    assert handled == [103]  # dispatched to the handler...
+    fwd = [m for m in sent_messages(ot) if m.msgType >= 100]
+    assert [m.msgType for m in fwd] == [100]  # ...not forwarded raw
+
+
+def test_close_delivers_deferred_ingest_run():
+    """Advisor r5 medium: a final user-space burst racing EOF into the
+    same event-loop batch (deferred _fast_run, then close before the 1ms
+    pump) must still reach the owner."""
+    if connection_mod._native_codec is None:
+        pytest.skip("native codec not built")
+    owner, ot = _owner_with_global()
+    conn, _ = auth_client()
+    ot.written.clear()
+
+    conn.on_bytes(_forward_wire([b"last-words"]))
+    assert conn._fast_run is not None  # deferred, pump hasn't run
+    conn.close(unexpected=True)  # EOF wins the race
+
+    gch = get_global_channel()
+    gch.tick_once(0)
+    owner.flush()
+    fwd = [m for m in sent_messages(ot) if m.msgType >= 100]
+    assert len(fwd) == 1
+    sfm = wire_pb2.ServerForwardMessage()
+    sfm.ParseFromString(fwd[0].msgBody)
+    assert sfm.payload == b"last-words"
+
+
+def test_stashed_batch_revalidates_fsm_at_dispatch():
+    """Advisor r5 low: a fast batch stashed behind a message that
+    transitions the FSM must be re-validated when the stash flushes —
+    the parse-time verdict is stale by then."""
+    if connection_mod._native_codec is None:
+        pytest.skip("native codec not built")
+    from channeld_tpu.core import channel as channel_mod
+
+    owner, ot = _owner_with_global()
+    conn, _ = auth_client()
+    ot.written.clear()
+
+    # OPEN allows everything but transitions to LOCKED on SUB (6);
+    # LOCKED rejects user space. No user-space transition exists, so the
+    # parse-time user_space_fast check passes in OPEN.
+    conn.fsm = MessageFsm.from_dict({
+        "States": [
+            {"Name": "OPEN", "MsgTypeWhitelist": "1-65535",
+             "MsgTypeBlacklist": ""},
+            {"Name": "LOCKED", "MsgTypeWhitelist": "1-99",
+             "MsgTypeBlacklist": ""},
+        ],
+        "InitState": "OPEN",
+        "Transitions": [
+            {"FromState": "OPEN", "ToState": "LOCKED", "MsgType": 6},
+        ],
+    })
+
+    cap = channel_mod.QUEUE_CAPACITY
+    channel_mod.QUEUE_CAPACITY = 0  # every external put stashes
+    try:
+        conn.on_bytes(wire(
+            MessageType.SUB_TO_CHANNEL,
+            control_pb2.SubscribedToChannelMessage(),
+        ))
+        assert conn.has_pending()
+        conn.on_bytes(_forward_wire([b"sneaky"]))  # batch stashes behind
+        assert len(conn._pending_msgs) == 2
+    finally:
+        channel_mod.QUEUE_CAPACITY = cap
+
+    before = conn._m_packet_dropped._value.get()
+    assert conn.flush_pending()  # SUB transitions OPEN -> LOCKED first
+    assert conn.fsm.current.name == "LOCKED"
+    assert conn._m_packet_dropped._value.get() == before + 1  # batch dropped
+
+    gch = get_global_channel()
+    gch.tick_once(0)
+    owner.flush()
+    assert [m for m in sent_messages(ot) if m.msgType >= 100] == []
+
+
+def test_flush_pending_ingest_skips_only_full_channels():
+    """Advisor r5 low: one conn blocked on a full channel must not delay
+    every other stashed conn to the next pump cycle — only conns whose
+    stash head targets a known-full channel are skipped."""
+    from channeld_tpu.core import channel as channel_mod
+    from channeld_tpu.core.channel import create_channel
+
+    _owner_with_global()
+    conn_a, _ = auth_client("stuck")
+    conn_b, _ = auth_client("fine")
+    sub = create_channel(ChannelType.SUBWORLD, None)
+
+    native = connection_mod._native_codec
+    connection_mod._native_codec = None  # per-message stash for conn_a
+    cap = channel_mod.QUEUE_CAPACITY
+    try:
+        channel_mod.QUEUE_CAPACITY = 0  # stash everything
+        p = wire_pb2.Packet(messages=[wire_pb2.MessagePack(
+            channelId=sub.id, msgType=101, msgBody=b"x")])
+        conn_a.on_bytes(encode_packet(p))
+        conn_b.on_bytes(wire(101, control_pb2.AuthMessage()))  # GLOBAL
+        assert conn_a.has_pending() and conn_b.has_pending()
+        assert conn_a.pending_head_channel() == sub.id
+        assert conn_b.pending_head_channel() == 0
+
+        # Keep ONLY the SUBWORLD channel full; GLOBAL drains.
+        channel_mod.QUEUE_CAPACITY = 2
+        sub.execute(lambda ch: None)
+        sub.execute(lambda ch: None)
+
+        # conn_a stashed first: the old break would starve conn_b here.
+        connection_mod._stash_retry.clear()
+        connection_mod._stash_retry[conn_a] = None
+        connection_mod._stash_retry[conn_b] = None
+        connection_mod.flush_pending_ingest()
+        assert conn_a.has_pending()  # still blocked on the full channel
+        assert not conn_b.has_pending()  # flushed in the SAME cycle
+        assert conn_b not in connection_mod._stash_retry
+    finally:
+        channel_mod.QUEUE_CAPACITY = cap
+        connection_mod._native_codec = native
+
+
+def test_close_counts_undeliverable_stash_as_dropped():
+    """A stash the full channel still refuses at close time dies with
+    the connection — but counted in packet_dropped, never silently."""
+    from channeld_tpu.core import channel as channel_mod
+
+    _owner_with_global()
+    conn, _ = auth_client("doomed")
+
+    native = connection_mod._native_codec
+    connection_mod._native_codec = None
+    cap = channel_mod.QUEUE_CAPACITY
+    try:
+        channel_mod.QUEUE_CAPACITY = 0  # everything stashes, nothing drains
+        conn.on_bytes(_forward_wire([b"a"]))
+        conn.on_bytes(_forward_wire([b"b"]))
+        assert len(conn._pending_msgs) == 2
+        before = conn._m_packet_dropped._value.get()
+        conn.close(unexpected=True)
+        assert conn._m_packet_dropped._value.get() == before + 2
+        assert not conn._pending_msgs
+    finally:
+        channel_mod.QUEUE_CAPACITY = cap
+        connection_mod._native_codec = native

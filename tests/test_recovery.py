@@ -279,3 +279,75 @@ def test_spatial_server_recovery_restores_block_ownership():
     data = testdata_pb2.TestChannelDataMessage()
     rmsg.channelData.Unpack(data)
     assert data.text == "cell" and data.num == 4
+
+
+def test_recover_handle_table_is_capped():
+    """Chaos hardening: with recover timeout 0 (never expires), repeated
+    unexpected server closes must not grow the handle table forever —
+    the oldest handle is evicted at the cap and the eviction counter
+    moves."""
+    from channeld_tpu.core import connection_recovery as rec
+    from channeld_tpu.core import metrics
+
+    cap = rec.MAX_RECOVER_HANDLES
+    rec.MAX_RECOVER_HANDLES = 3
+    try:
+        conns = []
+        for i in range(4):
+            t = FakeTransport()
+            conn = add_connection(t, ConnectionType.SERVER)
+            conn.pit = f"srv-{i}"
+            conns.append(conn)
+        before = metrics.recover_handles_evicted._value.get()
+        for conn in conns:
+            rec.make_recoverable(conn)
+        assert len(rec._recover_handles) == 3
+        assert "srv-0" not in rec._recover_handles  # oldest evicted
+        assert metrics.recover_handles_evicted._value.get() == before + 1
+    finally:
+        rec.MAX_RECOVER_HANDLES = cap
+
+
+def test_recover_handle_eviction_purges_channel_state_and_spares_in_progress():
+    """Eviction drops the PIT's per-channel RecoverableSubscriptions too
+    (the crash-loop leak lives there as well), and with every handle
+    mid-recovery the new close degrades to non-recoverable instead of
+    wedging a recovering peer."""
+    from channeld_tpu.core import connection_recovery as rec
+    from channeld_tpu.core.channel import get_global_channel
+
+    cap = rec.MAX_RECOVER_HANDLES
+    rec.MAX_RECOVER_HANDLES = 2
+    try:
+        conns = []
+        for i in range(2):
+            t = FakeTransport()
+            conn = add_connection(t, ConnectionType.SERVER)
+            conn.pit = f"evict-{i}"
+            conns.append(conn)
+            rec.make_recoverable(conn)
+        gch = get_global_channel()
+        gch.recoverable_subs["evict-0"] = object()
+
+        # Table full, evict-0 idle: a third close evicts it AND its
+        # stashed channel state.
+        t = FakeTransport()
+        extra = add_connection(t, ConnectionType.SERVER)
+        extra.pit = "evict-2"
+        rec.make_recoverable(extra)
+        assert "evict-0" not in rec._recover_handles
+        assert "evict-0" not in gch.recoverable_subs
+
+        # Every remaining handle mid-recovery: the next close must NOT
+        # evict one — it just isn't recoverable.
+        for h in rec._recover_handles.values():
+            h.new_conn = object()
+        t = FakeTransport()
+        last = add_connection(t, ConnectionType.SERVER)
+        last.pit = "evict-3"
+        rec.make_recoverable(last)
+        assert "evict-3" not in rec._recover_handles
+        assert last.recover_handle is None
+        assert len(rec._recover_handles) == 2  # nobody was wedged
+    finally:
+        rec.MAX_RECOVER_HANDLES = cap

@@ -228,3 +228,27 @@ def test_sidecar_stream_survives_malformed_request(sidecar):
     assert "mismatch" in responses[0].error
     assert responses[1].error == ""
     assert sum(responses[1].cellCounts) == 1  # the pipeline kept serving
+
+
+def test_sidecar_client_retries_transient_then_raises():
+    """Client hardening: transient codes (UNAVAILABLE from a dead
+    sidecar) retry with deterministic backoff, then surface; the retry
+    counter moves."""
+    import grpc
+
+    from channeld_tpu.core import metrics
+    from channeld_tpu.ops.service import SpatialDecisionClient
+
+    # A port nothing listens on: every attempt is UNAVAILABLE.
+    client = SpatialDecisionClient(
+        "127.0.0.1:1", timeout_s=0.5, max_retries=2, backoff_s=0.01
+    )
+    before = metrics.sidecar_call_retries.labels(
+        method="Configure")._value.get()
+    with pytest.raises(grpc.RpcError):
+        client.configure(gridCols=1, gridRows=1, gridWidth=1.0,
+                         gridHeight=1.0)
+    after = metrics.sidecar_call_retries.labels(
+        method="Configure")._value.get()
+    assert after - before == 2  # retried exactly max_retries times
+    client.close()

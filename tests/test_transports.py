@@ -93,6 +93,7 @@ def test_tcp_listener_end_to_end():
 
 
 def test_websocket_listener_end_to_end():
+    pytest.importorskip("websockets")
     run_gateway_and_client("ws", 23189, "ws://127.0.0.1:23189")
 
 
