@@ -31,6 +31,7 @@ from .data import (
     _channel_data_extension_registry,
     register_channel_data_type,
 )
+from .overload import governor as _governor
 from .settings import global_settings
 from .types import BroadcastType, ChannelType, ConnectionType, GLOBAL_CHANNEL_ID, MessageType
 
@@ -144,6 +145,11 @@ class Channel:
         self.removing = False
         self.recoverable_subs: dict = {}  # pit -> RecoverableSubscription
         self.logger = get_logger(f"channel.{self.channel_type.name}.{channel_id}")
+        # Labels never change: resolve the histogram child once, not per
+        # tick (same rationale as the per-connection metric children).
+        self._m_tick_duration = metrics.channel_tick_duration.labels(
+            channel_type=self.channel_type.name
+        )
         self._tick_task: Optional[asyncio.Task] = None
         self._wake = asyncio.Event()
         self._writer_task = None  # single-writer affinity (dev assertion)
@@ -383,11 +389,10 @@ class Channel:
     async def _tick_loop(self) -> None:
         while not self.is_removing():
             tick_start = time.monotonic()
+            # tick_once observes the duration histogram and feeds the
+            # overload governor's budget accounting.
             self.tick_once(self.get_time(), tick_start)
             elapsed = time.monotonic() - tick_start
-            metrics.channel_tick_duration.labels(
-                channel_type=self.channel_type.name
-            ).observe(elapsed)
             if not self._may_park():
                 await asyncio.sleep(max(self.tick_interval - elapsed, 0))
             else:
@@ -457,6 +462,17 @@ class Channel:
             )
         self._tick_connections()
         self._tick_recoverable_subscriptions()
+        # Per-tick budget accounting: observed here (not in the async
+        # loop) so synchronous tick_once drivers — tests, soak harnesses
+        # — feed the histogram and the overload governor too. The GLOBAL
+        # tick doubles as the governor's update cadence: it samples the
+        # ingest backlog/stash signals and moves the degradation ladder
+        # at most one step (doc/overload.md).
+        elapsed = time.monotonic() - tick_start
+        self._m_tick_duration.observe(elapsed)
+        _governor.note_tick(elapsed, self.tick_interval)
+        if self.channel_type == ChannelType.GLOBAL:
+            _governor.update(self.tick_interval)
 
     def _tick_messages(self, tick_start: float) -> None:
         """Drain the queue within the tick budget (ref: channel.go:389-412).

@@ -778,7 +778,11 @@ def _generate_conn_id(transport: Transport, max_conn_id: int) -> int:
 
 def add_connection(transport: Transport, conn_type: ConnectionType) -> Connection:
     """(ref: connection.go:260-345). Banned IPs are refused at the accept
-    point (ref: connection.go:228-235)."""
+    point (ref: connection.go:228-235); at overload L3 a deep
+    unauthenticated backlog refuses new CLIENT accepts outright (the
+    polite ServerBusyMessage refusal happens at AUTH — this hard gate
+    only protects the reactor floor from an accept storm that never
+    reaches auth; doc/overload.md)."""
     from .ddos import is_ip_banned
 
     addr = transport.remote_addr()
@@ -789,6 +793,20 @@ def add_connection(transport: Transport, conn_type: ConnectionType) -> Connectio
         except Exception:
             pass
         raise ConnectionRefusedError(f"banned IP {addr[0]}")
+    if conn_type == ConnectionType.CLIENT:
+        from .overload import governor
+
+        if governor.level >= 3:
+            from .ddos import _unauthenticated_connections
+
+            if (len(_unauthenticated_connections)
+                    > global_settings.overload_accept_headroom):
+                governor.count_shed("admission_accept")
+                try:
+                    transport.close()
+                except Exception:
+                    pass
+                raise ConnectionRefusedError("overload L3: accept refused")
     max_conn_id = (1 << global_settings.max_connection_id_bits) - 1
     conn_id = None
     for _ in range(100):

@@ -24,6 +24,7 @@ from ..protocol import control_pb2
 from ..utils.anyutil import pack_any, unpack_any
 from ..utils.fieldmask import filter_fields
 from ..utils.logger import get_logger
+from .overload import governor as _governor
 from .types import ChannelDataAccess, MessageType
 
 if TYPE_CHECKING:
@@ -146,6 +147,10 @@ class ChannelData:
         )
         self.msg_index = 0
         self.max_fanout_interval_ms = 0
+        # Arrival time (channel ns) of the newest update EVICTED from the
+        # ring: a subscriber whose catch-up window starts at or before
+        # this mark has a delta gap and must take a full-state resync.
+        self.evicted_through = 0
         self.extension: Optional[ChannelDataExtension] = None
 
     def on_update(
@@ -203,9 +208,18 @@ class ChannelData:
         )
         if len(self.update_msg_buffer) > MAX_UPDATE_MSG_BUFFER_SIZE:
             oldest = self.update_msg_buffer[0]
-            # Only drop it once every subscriber must have seen it.
-            if oldest.arrival_time + self.max_fanout_interval_ms * NS_PER_MS < arrival_time:
+            # Only drop it once every subscriber must have seen it. Under
+            # a brownout stretch the subscribers legitimately run slower,
+            # so the retention horizon stretches with them; subscribers
+            # held even longer (the L2+ priority shed) are caught by the
+            # evicted_through mark and resynced with full state.
+            retention_ns = self.max_fanout_interval_ms * NS_PER_MS
+            if _governor.level:
+                retention_ns = int(retention_ns * _governor.fanout_stretch())
+            if oldest.arrival_time + retention_ns < arrival_time:
                 self.update_msg_buffer.pop(0)
+                if oldest.arrival_time > self.evicted_through:
+                    self.evicted_through = oldest.arrival_time
 
 
 def _accumulate_window(data: "ChannelData", window: list, fresh: bool = False):
@@ -276,6 +290,14 @@ def tick_data(channel: "Channel", now: int) -> None:
     shared_windows: dict = {}
     body_cache: dict = {}  # id(update_msg) -> (msg ref, shared MessageContext)
 
+    # Overload brownout (doc/overload.md), resolved once per tick:
+    # L1+ stretches every subscriber's effective fan-out interval (the
+    # update ring keeps accumulating, so delivery coalesces — nothing is
+    # lost); L2+ withholds updates from the lowest-priority
+    # subscriptions entirely, each withheld delivery counted.
+    stretch = _governor.fanout_stretch() if _governor.level else 1.0
+    shed_floor = _governor.shed_priority_floor() if _governor.level else None
+
     queue = channel.fan_out_queue
     device = _device_due_view(channel)
     if device is not None:
@@ -311,16 +333,39 @@ def tick_data(channel: "Channel", now: int) -> None:
 
         #  |------FanOutDelay------|---FanOutInterval---|
         #  subTime                 firstFanOut          secondFanOut
-        next_fanout_time = foc.last_fanout_time + cs.options.fanOutIntervalMs * NS_PER_MS
+        interval_ns = cs.options.fanOutIntervalMs * NS_PER_MS
+        if stretch != 1.0:
+            interval_ns = int(interval_ns * stretch)
+        next_fanout_time = foc.last_fanout_time + interval_ns
         if device is None or foc.device_sub_slot is None:
             # Host time check (no engine, or no device slot for this sub).
             if now < next_fanout_time:
                 continue
         else:
-            # The device already decided this sub is due. The engine clock
-            # can run marginally ahead of this channel's; clamp the window
-            # end so the bisect below never claims unseen future arrivals.
+            # The device already decided this sub is due. Under a
+            # brownout stretch the governor overrides the device's
+            # cadence: hold the fan-out until the stretched interval
+            # elapses (the engine re-marks the sub due next window, so
+            # nothing is starved — just coalesced harder).
+            if stretch != 1.0 and now < next_fanout_time:
+                continue
+            # The engine clock can run marginally ahead of this
+            # channel's; clamp the window end so the bisect below never
+            # claims unseen future arrivals.
             next_fanout_time = min(next_fanout_time, now)
+
+        if (
+            shed_floor is not None
+            and cs.priority >= shed_floor
+            and foc.had_first_fanout
+        ):
+            # Shed: a DUE delivery is withheld while the ladder holds
+            # (first fan-out still goes out so fresh subs handshake) —
+            # one count per withheld delivery. The window keeps
+            # accumulating from last_fanout_time; delivery resumes,
+            # coalesced, once the ladder releases.
+            _governor.count_shed("update_priority")
+            continue
 
         latest_fanout_time = next_fanout_time
 
@@ -333,6 +378,18 @@ def tick_data(channel: "Channel", now: int) -> None:
             if device is not None and foc.device_sub_slot is not None:
                 # Mirror the window snap on the device sub clock.
                 ctl.device_sub_first_fanout(foc.device_sub_slot)
+        elif (
+            data.evicted_through > 0
+            and foc.last_fanout_time <= data.evicted_through
+        ):
+            # Ring gap: updates this subscriber never saw were evicted
+            # (it was held past the retention horizon — e.g. the L2+
+            # priority shed). Deltas can't reconstruct its view, so
+            # resync with full state — this is what keeps the brownout
+            # lossless at the STATE level no matter how long the hold.
+            fan_out_data_update(channel, conn, cs, data.msg, body_cache)
+            foc.last_message_index = data.msg_index
+            latest_fanout_time = now
         elif data.update_msg_buffer:
             if arrivals is None:
                 arrivals = [be.arrival_time for be in data.update_msg_buffer]

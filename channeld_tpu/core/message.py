@@ -243,6 +243,28 @@ def handle_auth(ctx: MessageContext) -> None:
         ctx.connection.close()
         return
 
+    # Overload admission control (doc/overload.md): at L3 new clients
+    # get a structured retry-after instead of service — the reactor
+    # floor belongs to the sessions already in. Servers are control
+    # plane and always admitted.
+    if ctx.connection.connection_type == ConnectionType.CLIENT:
+        from .overload import governor
+
+        decision = governor.admit_connection()
+        if not decision.admitted:
+            # Resuming sessions are exempt: a PIT with a live recovery
+            # handle was already admitted once, and serving the resume
+            # is far cheaper than burning its recoverable state.
+            from .connection_recovery import get_recover_handle
+
+            handle = get_recover_handle(msg.playerIdentifierToken)
+            if handle is None or handle.is_timed_out():
+                governor.count_shed("admission_connection")
+                _send_server_busy(ctx, decision)
+                ctx.connection.flush()  # the refusal must hit the wire...
+                ctx.connection.close()  # ...before teardown drops it
+                return
+
     provider = get_auth_provider()
     if provider is None and not global_settings.development:
         # run_server() refuses to boot in this state; if a hand-wired setup
@@ -324,6 +346,34 @@ def on_auth_complete(ctx: MessageContext, result, pit: str) -> None:
 
     events.auth_complete.broadcast(
         events.AuthEventData(connection=ctx.connection, player_identifier_token=pit)
+    )
+
+
+def _send_server_busy(ctx: MessageContext, decision) -> None:
+    """Reply to an admission-refused request with the structured
+    retry-after result (ServerBusyMessage, msgType 24)."""
+    busy = ctx.clone_for_send()
+    busy.msg_type = MessageType.SERVER_BUSY
+    busy.msg = control_pb2.ServerBusyMessage(
+        reason=decision.reason,
+        retryAfterMs=decision.retry_after_ms,
+        overloadLevel=_overload_level(),
+    )
+    ctx.connection.send(busy)
+
+
+def _overload_level() -> int:
+    from .overload import governor
+
+    return int(governor.level)
+
+
+def handle_server_busy(ctx: MessageContext) -> None:
+    """ServerBusyMessage is gateway -> peer only; receiving one here
+    means a confused (or hostile) peer echoed it back."""
+    logger.warning(
+        "unexpected ServerBusyMessage from conn %s (gateway-to-peer only)",
+        getattr(ctx.connection, "id", None),
     )
 
 
@@ -458,6 +508,22 @@ def handle_sub_to_channel(ctx: MessageContext) -> None:
     if conn_to_sub is None:
         logger.error("invalid connId %d for sub", msg.connId)
         return
+    # Overload admission control: at L3, NEW client self-subscriptions
+    # are refused with a structured retry-after (re-subscriptions merge
+    # options as usual — they are already being served, and server-
+    # driven subs are control plane).
+    if (
+        ctx.connection.connection_type == ConnectionType.CLIENT
+        and conn_to_sub is ctx.connection
+        and ctx.channel.subscribed_connections.get(conn_to_sub) is None
+    ):
+        from .overload import governor
+
+        decision = governor.admit_subscription()
+        if not decision.admitted:
+            governor.count_shed("admission_subscription")
+            _send_server_busy(ctx, decision)
+            return
     has_access, reason = check_acl(ctx.channel, ctx.connection, ChannelAccessType.SUB)
     if conn_to_sub.id != ctx.connection.id and not has_access:
         ctx.connection.logger.warning(
@@ -587,6 +653,7 @@ def init_message_map() -> None:
         (MessageType.UNSUB_FROM_CHANNEL, handle_unsub_from_channel),
         (MessageType.CHANNEL_DATA_UPDATE, handle_channel_data_update),
         (MessageType.DISCONNECT, handle_disconnect),
+        (MessageType.SERVER_BUSY, handle_server_busy),
         # CREATE_SPATIAL_CHANNEL shares the CreateChannelMessage body and
         # handler (ref: message.go:52-53).
         (MessageType.CREATE_SPATIAL_CHANNEL, handle_create_channel),

@@ -122,6 +122,38 @@ sidecar_call_retries = Counter(
     registry=registry,
 )
 
+# Overload-control plane (core/overload.py; doc/overload.md).
+overload_level = Gauge(
+    "overload_level",
+    "Current degradation-ladder level (0 normal .. 3 admission control)",
+    registry=registry,
+)
+overload_pressure = Gauge(
+    "overload_pressure",
+    "Smoothed overload pressure (1.0 == saturated on the worst signal)",
+    registry=registry,
+)
+overload_sheds = Counter(
+    "overload_sheds",
+    "Work shed by the overload governor (update_priority: low-priority "
+    "channel updates withheld; handover_fanout: redundant handover "
+    "payloads to already-subscribed dst clients skipped; "
+    "handover_defer: crossings re-offered next tick; "
+    "follow_interest_defer: follower-interest passes skipped; "
+    "admission_connection / admission_subscription: L3 refusals with a "
+    "ServerBusyMessage; admission_accept: raw CLIENT accepts refused at "
+    "the socket past the unauthenticated-backlog headroom)",
+    ["reason"],
+    registry=registry,
+)
+follower_interest_ms = Histogram(
+    "follower_interest_ms",
+    "Host cost of one _apply_follow_interests pass, milliseconds "
+    "(the previously-unmeasured share of the GLOBAL tick budget)",
+    buckets=(0.05, 0.1, 0.5, 1.0, 2.0, 5.0, 10.0, 33.0, 100.0),
+    registry=registry,
+)
+
 # The goroutine-count analog: live asyncio tasks (one per channel tick,
 # listener, pump). Updated by the server's heartbeat (serve loops) and by
 # any caller of sample_runtime().

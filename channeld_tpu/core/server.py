@@ -436,6 +436,13 @@ async def flush_loop(interval: float = 0.001) -> None:
         if now - last_sample >= 5.0:  # asyncio_tasks gauge (goroutines analog)
             last_sample = now
             metrics.sample_runtime()
+            # Re-publish the overload gauges on the same heartbeat so a
+            # scrape never reads a stale level after a quiet stretch
+            # (the governor also publishes on every transition).
+            from .overload import governor
+
+            metrics.overload_level.set(int(governor.level))
+            metrics.overload_pressure.set(governor.pressure)
         await asyncio.sleep(interval)
 
 
@@ -473,6 +480,14 @@ async def run_server(argv: Optional[list[str]] = None) -> None:
     init_connections(global_settings.server_fsm, global_settings.client_fsm)
     init_channels()
     init_anti_ddos()
+    if global_settings.overload_enabled:
+        logger.info(
+            "overload governor armed: ladder L0-L3, enter=%s exit=%s, "
+            "retry-after %dms (doc/overload.md)",
+            global_settings.overload_enter_thresholds,
+            global_settings.overload_exit_thresholds,
+            global_settings.overload_retry_after_ms,
+        )
 
     # Fail boot on a missing auth provider outside development: raising at
     # auth time would be swallowed by the per-message isolator and the

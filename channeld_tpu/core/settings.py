@@ -129,6 +129,37 @@ class GlobalSettings:
     # Empty = the injector stays disarmed and every hook is a no-op.
     chaos_config: str = ""
 
+    # Overload governor (new — doc/overload.md). The four-level
+    # degradation ladder: enter/exit thresholds are deliberately apart
+    # (hysteresis), the ladder moves one step per GLOBAL tick at most,
+    # and de-escalation additionally requires the smoothed pressure to
+    # hold under the exit threshold for overload_down_hold_s.
+    # Thresholds are budget-utilization style (1.0 == the tick exactly
+    # spends its budget): degradation starts when the gateway OVERRUNS,
+    # not when it is merely busy — a tick at 80% of budget is healthy.
+    overload_enabled: bool = True
+    overload_alpha: float = 0.25  # EWMA smoothing of the raw pressure
+    overload_enter_thresholds: tuple = (0.95, 1.15, 1.40)  # L1/L2/L3
+    overload_exit_thresholds: tuple = (0.75, 1.00, 1.20)
+    overload_up_hold_ticks: int = 3
+    overload_down_hold_s: float = 2.0
+    # After a step down, up-transitions wait out this cooldown so the
+    # release itself (resumed fan-outs, full-state resyncs, the
+    # deferred-handover drain) cannot bounce the ladder straight back
+    # up. If the release work is genuinely heavy the governor may still
+    # re-brake afterwards — by design it just never climbs above the
+    # overload's own peak on the way down.
+    overload_up_cooldown_s: float = 3.0
+    overload_l1_stretch: float = 2.0  # fan-out interval multiplier
+    overload_l2_stretch: float = 4.0
+    overload_backlog_norm: int = 64  # stash-parked conns == pressure 1.0
+    # L3 hard accept gate: unauthenticated connections tolerated before
+    # raw CLIENT accepts are refused outright (separate knob from the
+    # pressure normalizer above — they tune independently).
+    overload_accept_headroom: int = 256
+    overload_handover_batch_cap: int = 256  # crossings/tick at L2+
+    overload_retry_after_ms: int = 2000  # ServerBusyMessage back-off
+
     # Device mesh for the spatial engine: 0 devices = single-device step;
     # N>0 shards the entity arrays over the first N jax devices, and
     # hosts>1 arranges them as a (hosts, chips) DCN x ICI mesh — the TPU
@@ -225,6 +256,20 @@ class GlobalSettings:
         p.add_argument("-chaos", type=str, default="",
                        help="chaos scenario JSON path; arms deterministic "
                             "fault injection (doc/chaos.md)")
+        p.add_argument("-overload",
+                       type=lambda s: s.lower() not in
+                       ("false", "0", "no", "off"),
+                       default=self.overload_enabled,
+                       help="adaptive overload-control ladder "
+                            "(doc/overload.md); false pins L0")
+        p.add_argument("-overload-retry-after", type=int,
+                       default=self.overload_retry_after_ms,
+                       help="retry-after (ms) in L3 ServerBusyMessage "
+                            "admission refusals")
+        p.add_argument("-overload-down-hold", type=float,
+                       default=self.overload_down_hold_s,
+                       help="seconds the pressure must hold under the exit "
+                            "threshold before the ladder steps down")
         p.add_argument("-mesh-devices", type=int, default=self.tpu_mesh_devices,
                        help="shard the spatial engine over N devices "
                             "(0 = single-device step)")
@@ -262,6 +307,9 @@ class GlobalSettings:
         self.max_failed_auth_attempts = args.mfaa
         self.max_fsm_disallowed = args.mfd
         self.chaos_config = args.chaos
+        self.overload_enabled = args.overload
+        self.overload_retry_after_ms = args.overload_retry_after
+        self.overload_down_hold_s = args.overload_down_hold
         self.spatial_backend = args.spatial_backend
         self.tpu_mesh_devices = args.mesh_devices
         self.tpu_mesh_hosts = args.mesh_hosts

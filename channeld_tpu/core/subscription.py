@@ -14,8 +14,18 @@ from typing import TYPE_CHECKING, Optional
 
 from ..protocol import control_pb2
 from .data import FanOutConnection, NS_PER_MS
+from .overload import sub_priority
 from .settings import global_settings
-from .types import ChannelDataAccess, ChannelType
+from .types import ChannelDataAccess, ChannelType, ConnectionType
+
+
+def _priority_for(conn, options, st) -> int:
+    """Overload shed priority: SERVER connections are authority/control
+    plane and always priority 0 (never shed) regardless of options;
+    clients derive theirs from the subscription options."""
+    if getattr(conn, "connection_type", None) == ConnectionType.SERVER:
+        return 0
+    return sub_priority(options, st.default_fanout_interval_ms)
 
 if TYPE_CHECKING:
     from .channel import Channel
@@ -26,6 +36,10 @@ class ChannelSubscription:
     options: control_pb2.ChannelSubscriptionOptions
     sub_time: int  # ns, channel time
     fanout_conn: FanOutConnection
+    # Overload shed priority from the options (0 WRITE-access authority,
+    # 1 READ at default cadence, 2 slower observers); the governor's L2+
+    # update shed keys off this (core/overload.py).
+    priority: int = 1
 
 
 def default_sub_options(channel_type: int) -> control_pb2.ChannelSubscriptionOptions:
@@ -51,6 +65,7 @@ def subscribe_to_channel(
     if conn.is_closing():
         return None, False
 
+    st_view = global_settings.channel_settings_view(ch.channel_type)
     cs = ch.subscribed_connections.get(conn)
     if cs is not None:
         data_access_changed = False
@@ -59,6 +74,7 @@ def subscribe_to_channel(
             before_interval = cs.options.fanOutIntervalMs
             cs.options.MergeFrom(options)
             data_access_changed = before != cs.options.dataAccess
+            cs.priority = _priority_for(conn, cs.options, st_view)
             if cs.options.fanOutIntervalMs != before_interval:
                 slot = cs.fanout_conn.device_sub_slot
                 if slot is not None:
@@ -87,7 +103,10 @@ def subscribe_to_channel(
         # Delay the first fan-out so spawn messages can arrive first.
         last_fanout_time=now + merged.fanOutDelayMs * NS_PER_MS,
     )
-    cs = ChannelSubscription(options=merged, sub_time=now, fanout_conn=foc)
+    cs = ChannelSubscription(
+        options=merged, sub_time=now, fanout_conn=foc,
+        priority=_priority_for(conn, merged, st_view),
+    )
     ch.fan_out_queue.insert(0, foc)
 
     if ch.data is not None and ch.data.max_fanout_interval_ms < merged.fanOutIntervalMs:

@@ -67,6 +67,7 @@ class MessageType(IntEnum):
     RECOVERY_END = 21
     CHANNEL_OWNER_LOST = 22
     CHANNEL_OWNER_RECOVERED = 23
+    SERVER_BUSY = 24
     DEBUG_GET_SPATIAL_REGIONS = 99
     USER_SPACE_START = 100
 

@@ -38,6 +38,7 @@ MESSAGE_TEMPLATES = {
     21: control_pb2.EndRecoveryMessage,
     22: control_pb2.ChannelOwnerLostMessage,
     23: control_pb2.ChannelOwnerRecoveredMessage,
+    24: control_pb2.ServerBusyMessage,
     99: spatial_pb2.DebugGetSpatialRegionsMessage,
 }
 

@@ -19,6 +19,7 @@ from __future__ import annotations
 import math
 from typing import Callable, Optional
 
+from ..core.overload import governor as _governor
 from ..core.settings import global_settings
 from ..core.types import ChannelType, ConnectionType, MessageType
 from ..protocol import control_pb2, spatial_pb2
@@ -522,6 +523,15 @@ class StaticGrid2DSpatialController:
 
         src_channel.execute(_remove)
         dst_channel.execute(_add)
+        # Placement hook: the entity data's move is now committed (both
+        # executes are queued FIFO in their channels). Controllers that
+        # keep an authoritative placement ledger (the TPU controller's
+        # _data_cell, which de-duplicates stale engine re-detections)
+        # update it HERE — after the move is real, never on a skipped
+        # orchestration (missing entity channel, locked group, ...).
+        moved_hook = getattr(self, "_note_entity_data_moved", None)
+        if moved_hook is not None:
+            moved_hook(list(handover_entities), dst_channel_id)
 
         # Step 3: identifier-only handover payload for src-side connections.
         spatial_data_msg = reflect_channel_data_message(ChannelType.SPATIAL)
@@ -550,16 +560,30 @@ class StaticGrid2DSpatialController:
 
         src_conns = src_channel.get_all_connections()
         dst_conns = dst_channel.get_all_connections()
+        # Overload L2+: only REDUNDANT handover payloads are shed — dst
+        # clients already subscribed to every moved entity (their state
+        # keeps flowing through the entity channels). The src-side
+        # identifier-only message is load-bearing (it is the only signal
+        # that the entity LEFT the cell; entity removal cannot ride a
+        # map-merge delta) and, post-batching, one shared encode — it is
+        # never withheld.
+        defer_fanout = _governor.defer_handover_fanout()
 
         # Step 4-1: src-only connections get the identifier-only payload.
-        for conn in src_conns - dst_conns:
-            conn.send(
-                MessageContext(
-                    msg_type=MessageType.CHANNEL_DATA_HANDOVER,
-                    msg=base_msg,
-                    channel_id=dst_channel_id,
-                )
+        # ONE context, encoded once, shared by every recipient (the
+        # queued sender consumes fields into a tuple immediately) — the
+        # per-recipient rebuild+re-encode was the dominant share of the
+        # 21.8us/handover host cost at r5 load.
+        src_only = src_conns - dst_conns
+        if src_only:
+            shared = MessageContext(
+                msg_type=MessageType.CHANNEL_DATA_HANDOVER,
+                msg=base_msg,
+                channel_id=dst_channel_id,
             )
+            shared.ensure_raw_body()
+            for conn in src_only:
+                conn.send(shared)
 
         # Step 4-2: dst connections are auto-subscribed to the entity
         # channels (WRITE for the new owner) and receive full entity data
@@ -587,11 +611,21 @@ class StaticGrid2DSpatialController:
             _targets.append(
                 (entity_ch, getattr(entity_data, "merge_to", None))
             )
+        # Grouped per connection: the subscription pass runs first (state
+        # must stay exact even under overload deferral), then exactly one
+        # handover message per conn — and conns whose subscription state
+        # didn't change all carry the identical payload, so it is built
+        # and encoded once and the context shared across them.
+        dst_owner = dst_channel.get_owner()
+        shared_ctx = None  # the no-new-subscription payload, lazily built
         for conn in dst_conns:
-            handover_data_msg = type(spatial_data_msg)()
-            initializer = getattr(handover_data_msg, "init_data", None)
-            if callable(initializer):
-                initializer()
+            if conn is None or conn.is_closing():
+                # A mid-disconnect conn would subscribe to nothing and
+                # build an EMPTY payload — which must never become the
+                # cached shared_ctx served to healthy recipients.
+                continue
+            any_new = False
+            merges = []
             for entity_ch, merger in _targets:
                 sub_options = (
                     _write_opts if conn is entity_ch.get_owner() else _read_opts
@@ -601,21 +635,51 @@ class StaticGrid2DSpatialController:
                     continue
                 if should_send:
                     send_subscribed(conn, entity_ch, conn, 0, cs.options)
+                    any_new = True
+                merges.append((merger, should_send))
+            if (
+                defer_fanout
+                and not any_new
+                and conn is not dst_owner
+                and conn.connection_type == ConnectionType.CLIENT
+            ):
+                # Redundant for this recipient: it was already subscribed
+                # to every moved entity (no new sub -> no full state in
+                # the payload it would miss), and the entity channels'
+                # own fan-out keeps carrying the state. A conn with ANY
+                # new subscription still gets the message — it carries
+                # that entity's full state (skipFirstFanOut skipped the
+                # usual full-state send on purpose).
+                _governor.count_shed("handover_fanout")
+                continue
+            if not any_new and shared_ctx is not None:
+                conn.send(shared_ctx)
+                continue
+            handover_data_msg = type(spatial_data_msg)()
+            initializer = getattr(handover_data_msg, "init_data", None)
+            if callable(initializer):
+                initializer()
+            for merger, should_send in merges:
                 if callable(merger):
                     # Full state for new subscribers.
                     merger(handover_data_msg, should_send)
-            conn.send(
-                MessageContext(
-                    msg_type=MessageType.CHANNEL_DATA_HANDOVER,
-                    msg=spatial_pb2.ChannelDataHandoverMessage(
-                        srcChannelId=src_channel_id,
-                        dstChannelId=dst_channel_id,
-                        contextConnId=context_conn_id,
-                        data=pack_any(handover_data_msg),
-                    ),
-                    channel_id=dst_channel_id,
-                )
+            ctx_out = MessageContext(
+                msg_type=MessageType.CHANNEL_DATA_HANDOVER,
+                msg=spatial_pb2.ChannelDataHandoverMessage(
+                    srcChannelId=src_channel_id,
+                    dstChannelId=dst_channel_id,
+                    contextConnId=context_conn_id,
+                    data=pack_any(handover_data_msg),
+                ),
+                channel_id=dst_channel_id,
             )
+            ctx_out.ensure_raw_body()
+            # Cache only a payload that covered every entity in the pair
+            # (a partial build — e.g. a subscription refused mid-loop —
+            # must not be replayed to other recipients).
+            if not any_new and len(merges) == len(_targets):
+                shared_ctx = ctx_out
+            conn.send(ctx_out)
 
 
 register_spatial_controller_type(

@@ -477,6 +477,8 @@ async def run_soak(p: SoakParams) -> dict:
 
     t_start = time.monotonic()
 
+    from channeld_tpu.core.overload import reset_overload
+
     # -- fresh runtime (idempotent; the pytest smoke shares a process) --
     channel_mod.reset_channels()
     connection_mod.reset_connections()
@@ -485,6 +487,7 @@ async def run_soak(p: SoakParams) -> dict:
     recovery_mod.reset_recovery()
     reset_spatial_controller()
     reset_global_settings()
+    reset_overload()
 
     global_settings.development = True
     global_settings.tpu_entity_capacity = p.entity_capacity
@@ -721,6 +724,7 @@ async def run_soak(p: SoakParams) -> dict:
         recovery_mod.reset_recovery()
         reset_spatial_controller()
         reset_global_settings()
+        reset_overload()
         try:
             os.remove(merged_path)
         except OSError:

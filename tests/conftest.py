@@ -53,8 +53,9 @@ if not any(
 @pytest.fixture(autouse=True)
 def _fresh_globals():
     """Reset process-wide singletons between tests."""
-    from channeld_tpu.core import events, settings
+    from channeld_tpu.core import events, overload, settings
 
     yield
     events.reset_all()
     settings.reset_global_settings()
+    overload.reset_overload()

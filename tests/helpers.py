@@ -105,6 +105,7 @@ def fresh_runtime():
     from channeld_tpu.core import ddos as ddos_mod
     from channeld_tpu.core import connection_recovery as recovery_mod
     from channeld_tpu.core.message import init_message_map
+    from channeld_tpu.core.overload import reset_overload
     from channeld_tpu.spatial.controller import reset_spatial_controller
 
     channel_mod.reset_channels()
@@ -113,6 +114,7 @@ def fresh_runtime():
     ddos_mod.reset_ddos()
     recovery_mod.reset_recovery()
     reset_spatial_controller()
+    reset_overload()
     init_message_map()
     channel_mod.init_channels()
     return channel_mod.get_global_channel()

@@ -624,6 +624,75 @@ def test_chaos_full_soak_120s():
     assert report["invariants"]["ok"], failed
 
 
+def _validate_overload_artifact(report: dict) -> list[str]:
+    """Schema check for the overload-soak artifact (SOAK_OVERLOAD_*.json):
+    the keys the acceptance criteria and the operator runbook
+    (doc/overload.md) read. Returns a list of violations."""
+    errs = []
+
+    def need(d, key, typ, where):
+        if key not in d:
+            errs.append(f"{where}: missing '{key}'")
+            return None
+        if typ is not None and not isinstance(d[key], typ):
+            errs.append(f"{where}: '{key}' is {type(d[key]).__name__}, "
+                        f"want {typ}")
+            return None
+        return d[key]
+
+    if need(report, "kind", str, "root") != "overload_soak":
+        errs.append("root: kind != overload_soak")
+    need(report, "scenario", dict, "root")
+    need(report, "max_level", int, "root")
+    need(report, "tick_p99_per_level", dict, "root")
+    tl = need(report, "timeline", list, "root") or []
+    for i, s in enumerate(tl[:3]):
+        for k in ("t", "level", "pressure"):
+            need(s, k, (int, float), f"timeline[{i}]")
+    gov = need(report, "governor", dict, "root") or {}
+    trans = need(gov, "transitions", list, "governor") or []
+    for i, t in enumerate(trans):
+        for k in ("t", "from", "to"):
+            need(t, k, (int, float), f"transitions[{i}]")
+    need(gov, "shed_counts", dict, "governor")
+    inv = need(report, "invariants", dict, "root") or {}
+    need(inv, "ok", bool, "invariants")
+    for i, c in enumerate(need(inv, "checks", list, "invariants") or []):
+        need(c, "name", str, f"checks[{i}]")
+        need(c, "ok", bool, f"checks[{i}]")
+    stats = need(report, "stats", dict, "root") or {}
+    need(stats, "sheds", dict, "stats")
+    # The acceptance-bar checks must be present by name.
+    names = {c.get("name") for c in inv.get("checks", [])}
+    for required in (
+        "ladder_reached_at_least_L2",
+        "ladder_moves_one_step_at_a_time",
+        "returned_to_L0_within_deadline",
+        "no_lost_entity_tracking",
+        "every_entity_in_exactly_one_cell",
+        "shed_accounting_exact",
+    ):
+        if required not in names:
+            errs.append(f"invariants: missing check '{required}'")
+    return errs
+
+
+def test_overload_soak_artifact_schema():
+    """The committed acceptance artifact must satisfy the schema the
+    runbook and the acceptance criteria read (and stay green)."""
+    path = os.path.join(REPO, "SOAK_OVERLOAD_r07.json")
+    if not os.path.exists(path):
+        pytest.skip("acceptance artifact not present in this checkout")
+    import json
+
+    with open(path) as f:
+        report = json.load(f)
+    errs = _validate_overload_artifact(report)
+    assert errs == []
+    assert report["invariants"]["ok"] is True
+    assert report["max_level"] >= 2
+
+
 def test_scenario_round_trips_through_artifact_form():
     """Scenario.to_dict (what SOAK_*.json embeds) must load back via
     from_dict — the replay-from-artifact workflow depends on it."""

@@ -787,6 +787,70 @@ def test_flush_pending_ingest_skips_only_full_channels():
         connection_mod._native_codec = native
 
 
+def test_flush_pending_ingest_multiple_distinct_full_channels():
+    """Extends the PR-1 stash-retry fix: TWO distinct channels full in
+    the SAME flush_pending_ingest cycle. Conns blocked on either full
+    channel are skipped (each full channel discovered at most once per
+    cycle), while a conn targeting a drained third channel flushes in
+    that same cycle — and each blocked conn drains as soon as ITS
+    channel frees, independent of the other full channel."""
+    from channeld_tpu.core import channel as channel_mod
+    from channeld_tpu.core.channel import create_channel
+
+    _owner_with_global()
+    conn_a, _ = auth_client("stuck-on-a")
+    conn_b, _ = auth_client("stuck-on-b")
+    conn_c, _ = auth_client("fine")
+    sub_a = create_channel(ChannelType.SUBWORLD, None)
+    sub_b = create_channel(ChannelType.SUBWORLD, None)
+
+    native = connection_mod._native_codec
+    connection_mod._native_codec = None  # per-message stash path
+    cap = channel_mod.QUEUE_CAPACITY
+    try:
+        channel_mod.QUEUE_CAPACITY = 0  # stash everything
+        for conn, target in ((conn_a, sub_a.id), (conn_b, sub_b.id)):
+            conn.on_bytes(encode_packet(wire_pb2.Packet(
+                messages=[wire_pb2.MessagePack(
+                    channelId=target, msgType=101, msgBody=b"x")])))
+        conn_c.on_bytes(wire(101, control_pb2.AuthMessage()))  # GLOBAL
+        assert conn_a.pending_head_channel() == sub_a.id
+        assert conn_b.pending_head_channel() == sub_b.id
+        assert conn_c.pending_head_channel() == 0
+
+        # BOTH subworld channels stay full; only GLOBAL drains.
+        channel_mod.QUEUE_CAPACITY = 2
+        for sub in (sub_a, sub_b):
+            sub.execute(lambda ch: None)
+            sub.execute(lambda ch: None)
+
+        connection_mod._stash_retry.clear()
+        connection_mod._stash_retry[conn_a] = None
+        connection_mod._stash_retry[conn_b] = None
+        connection_mod._stash_retry[conn_c] = None
+        connection_mod.flush_pending_ingest()
+        assert conn_a.has_pending() and conn_b.has_pending()
+        assert not conn_c.has_pending()  # drained-channel conn: same cycle
+        assert conn_c not in connection_mod._stash_retry
+
+        # Channel B frees; A stays full. Only conn_b must drain — the
+        # full channel A must not hold it (nor vice versa).
+        sub_b.tick_once(0)
+        connection_mod.flush_pending_ingest()
+        assert conn_a.has_pending()  # its channel is still full
+        assert not conn_b.has_pending()
+        assert conn_b not in connection_mod._stash_retry
+
+        # Finally A frees too: nothing left behind.
+        sub_a.tick_once(0)
+        connection_mod.flush_pending_ingest()
+        assert not conn_a.has_pending()
+        assert connection_mod._stash_retry == {}
+    finally:
+        channel_mod.QUEUE_CAPACITY = cap
+        connection_mod._native_codec = native
+
+
 def test_close_counts_undeliverable_stash_as_dropped():
     """A stash the full channel still refuses at close time dies with
     the connection — but counted in packet_dropped, never silently."""
