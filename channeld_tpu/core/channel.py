@@ -276,7 +276,11 @@ class Channel:
             # swaps in a fresh set every pump cycle.
             _connection_mod._pending_flush.add(owner)
         else:
-            # Rate-limited like the per-message ownerless path.
+            # Every drop is counted (failover keys alerts off this);
+            # the log stays rate-limited like the per-message path.
+            metrics.ownerless_drops.labels(
+                channel_type=self.channel_type.name
+            ).inc(len(entries))
             now = time.monotonic()
             if now - getattr(self, "_ownerless_warn_at", 0.0) > 1.0:
                 self._ownerless_warn_at = now
@@ -669,6 +673,13 @@ def init_channels() -> None:
     global _global_channel, _non_spatial_alloc, _spatial_alloc
     if _global_channel is not None:
         return
+    # World boot doubles as the failover plane's install point: its
+    # ServerLost listener must exist before any recoverable server can
+    # die, and a fresh world starts with empty re-host/journal ledgers.
+    from .failover import plane, reset_failover
+
+    reset_failover()
+    plane.install()
     _non_spatial_alloc = IdAllocator(1, global_settings.spatial_channel_id_start - 1)
     _spatial_alloc = IdAllocator(
         global_settings.spatial_channel_id_start,

@@ -96,8 +96,11 @@ def make_recoverable(conn: "Connection") -> None:
             )
             return
         oldest = min(idle, key=lambda p: _recover_handles[p].disconn_time)
-        del _recover_handles[oldest]
-        _purge_recoverable_subs(oldest)
+        # An evicted server can never recover — same terminal fate as a
+        # window expiry, so it takes the same single ServerLost path
+        # (stash purge + one event; failover re-hosts its cells).
+        expire_recover_handle(oldest, _recover_handles[oldest],
+                              reason="evicted")
         metrics.recover_handles_evicted.inc()
         logger.warning(
             "recovery handle table full (%d); evicted oldest idle pit %s",
@@ -110,15 +113,56 @@ def make_recoverable(conn: "Connection") -> None:
     conn.recover_handle = handle
 
 
-def _purge_recoverable_subs(pit: str) -> None:
-    """Drop a PIT's stashed per-channel recovery state. Without this, an
-    evicted (or timed-out-with-timeout-0: never) handle would leave a
-    RecoverableSubscription in every channel the server subscribed to —
-    the crash-loop leak the handle cap exists to stop lives there too."""
-    from .channel import all_channels
+def expire_recover_handle(
+    pit: str, handle: ConnectionRecoverHandle, reason: str = "timeout"
+) -> bool:
+    """THE server-dead-for-good path. Every way a recovery can end
+    without the server returning — window expiry noticed by the reaper
+    loop, expiry noticed by a channel tick, handle eviction at the table
+    cap — funnels here, so failover, metrics and tests all key off ONE
+    ``ServerLostEvent`` per loss. Idempotent: only the caller that still
+    finds the handle installed processes it.
 
-    for ch in all_channels().values():
-        ch.recoverable_subs.pop(pit, None)
+    Collects (and purges) the dead server's per-channel recovery stash —
+    without the purge, a crash-looping fleet would leak a
+    RecoverableSubscription into every channel each server subscribed
+    to. Channels configured to die with their owner still do; everything
+    else is left for the failover plane (spatial cells re-host, other
+    types stay ownerless with their drops counted)."""
+    if _recover_handles.get(pit) is not handle:
+        return False
+    del _recover_handles[pit]
+    from . import events, metrics
+    from .channel import _remove_channel_after_owner_removed, all_channels
+
+    owned: list[int] = []
+    subscribed: list[int] = []
+    for ch in list(all_channels().values()):
+        rsub = ch.recoverable_subs.pop(pit, None)
+        if rsub is None:
+            continue
+        if getattr(rsub, "is_owner", False):
+            owned.append(ch.id)
+            if global_settings.get_channel_settings(
+                ch.channel_type
+            ).remove_channel_after_owner_removed:
+                _remove_channel_after_owner_removed(ch)
+        else:
+            subscribed.append(ch.id)
+    metrics.server_lost.inc()
+    logger.warning(
+        "server %s (conn %d) lost for good (%s): %d owned / %d "
+        "subscribed channels stashed",
+        pit, handle.prev_conn_id, reason, len(owned), len(subscribed),
+    )
+    events.server_lost.broadcast(events.ServerLostData(
+        pit=pit,
+        prev_conn_id=handle.prev_conn_id,
+        owned_channel_ids=owned,
+        subscribed_channel_ids=subscribed,
+        reason=reason,
+    ))
+    return True
 
 
 def recover_from_handle(conn: "Connection", handle: ConnectionRecoverHandle) -> None:
@@ -149,7 +193,7 @@ def tick_connection_recovery_once() -> None:
 
     for pit, handle in list(_recover_handles.items()):
         if handle.is_timed_out():
-            del _recover_handles[pit]
+            expire_recover_handle(pit, handle)
             continue
         if handle.new_conn is None:
             continue
@@ -173,19 +217,18 @@ async def connection_recovery_loop() -> None:
 
 def tick_recoverable_subscriptions(ch: "Channel") -> None:
     """Per-channel recovery tick (ref: connection_recovery.go:94-171)."""
-    from .channel import _remove_channel_after_owner_removed
     from .message import MessageContext
     from .subscription import subscribe_to_channel
 
     for pit, rsub in list(ch.recoverable_subs.items()):
         handle = rsub.conn_handle
         if handle.is_timed_out():
-            ch.recoverable_subs.clear()
-            if global_settings.get_channel_settings(
-                ch.channel_type
-            ).remove_channel_after_owner_removed:
-                _remove_channel_after_owner_removed(ch)
-            break
+            # Per-PIT expiry through the single ServerLost path (which
+            # also pops this channel's stash). The old in-place clear
+            # wiped OTHER servers' stashes on this channel and never
+            # told anyone the server was gone.
+            expire_recover_handle(pit, handle)
+            continue
 
         if handle.new_conn is None:
             continue

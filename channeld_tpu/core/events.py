@@ -30,6 +30,24 @@ class SpatialOwnershipData:
     spatial_channel: Any
 
 
+@dataclass
+class ServerLostData:
+    """A recoverable server connection is gone FOR GOOD: its recovery
+    window expired (or its handle was evicted) without the server
+    returning. Fired exactly once per loss, from the single expiry path
+    (core/connection_recovery.py expire_recover_handle) — failover,
+    metrics and tests all key off this one event (doc/failover.md)."""
+
+    pit: str
+    prev_conn_id: int
+    # Channel ids the dead server OWNED (any type; the failover plane
+    # re-hosts the spatial ones and re-points entity channels).
+    owned_channel_ids: list
+    # Channel ids it was merely subscribed to (already pruned).
+    subscribed_channel_ids: list
+    reason: str = "timeout"  # "timeout" | "evicted"
+
+
 # Fired when the GLOBAL channel gains/loses an owner connection.
 global_channel_possessed: Event[Any] = Event("GlobalChannelPossessed")
 global_channel_unpossessed: Event[Any] = Event("GlobalChannelUnpossessed")
@@ -45,6 +63,11 @@ entity_channel_spatially_owned: Event[SpatialOwnershipData] = Event(
     "EntityChannelSpatiallyOwned"
 )
 
+# Fired once when a recoverable server's recovery window expires without
+# the server coming back — the dead-for-good signal the failover plane,
+# metrics and tests all share (doc/failover.md).
+server_lost: Event[ServerLostData] = Event("ServerLost")
+
 
 def reset_all() -> None:
     """Test hook: drop all listeners so tests stay independent."""
@@ -57,6 +80,7 @@ def reset_all() -> None:
         auth_complete,
         fsm_disallowed,
         entity_channel_spatially_owned,
+        server_lost,
     ):
         ev._handlers.clear()
         ev._waiters.clear()

@@ -1,0 +1,522 @@
+"""Spatial authority failover: cell re-hosting + transactional handover.
+
+Beyond-reference capability (the reference pkg/channeld has recovery for
+servers that COME BACK, but a server that dies for good leaves its
+spatial and entity channels ownerless forever — every update to them is
+dropped). This module closes that gap, in the authority-re-assignment
+tradition of geo-replicated service architectures (PAPERS.md: Spider's
+replicated-authoritative-state failover): the gateway already holds the
+authoritative ChannelData for every cell, so when a recoverable server's
+recovery window expires (``ServerLostEvent``), the orphaned cells are
+re-hosted onto surviving spatial servers instead of going dark.
+
+Two cooperating pieces (doc/failover.md):
+
+- :class:`HandoverJournal` — a per-entity prepare -> commit/abort ledger
+  wrapped around the cross-cell handover orchestration
+  (``spatial/grid.py _orchestrate_pair``). The data move runs as two
+  queued ``Channel.execute`` hops (remove in the src tick, add in the
+  dst tick); the journal records the transaction so a server crash (or
+  channel removal) between the hops deterministically resolves to
+  exactly ONE owning cell — never a duplicated or lost entity. The
+  authoritative ``_data_cell`` placement ledger only flips on COMMIT
+  (the add actually ran); aborted handovers re-add the data to the src
+  cell through the same FIFO queue and are re-offered after failover.
+
+- :class:`FailoverPlane` — listens for ``ServerLostEvent``, then (inside
+  the GLOBAL channel tick, the same execution context as handover
+  orchestration): resolves in-flight journal records, picks surviving
+  spatial servers by load (fewest owned cells, tie-break lowest conn
+  id), re-hosts each orphaned cell (owner + WRITE subscription +
+  authoritative-state bootstrap reusing the snapshot pack path),
+  re-points orphaned entity channels to their cell's new owner, forces a
+  full-state resync for every remaining subscriber, and emits structured
+  ``CellRehostedMessage`` notifications (msgType 25) so engine SDKs can
+  respawn authority.
+
+Every re-host/abort is counted twice on purpose — prometheus counters
+AND python-side ledgers — so the failover soak
+(``scripts/failover_soak.py``) proves the accounting exact.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..utils.logger import get_logger
+from .settings import global_settings
+from .types import ChannelDataAccess, MessageType
+
+logger = get_logger("failover")
+
+# Handover-journal record states. PREPARED -> REMOVED happens in the src
+# cell's tick, -> COMMITTED in the dst cell's tick; ABORTED is the
+# failover resolution when the dst can never run its add.
+PREPARED = "prepared"
+REMOVED = "removed"
+COMMITTED = "committed"
+ABORTED = "aborted"
+
+
+@dataclass
+class HandoverRecord:
+    txn_id: int
+    entity_id: int
+    src_channel_id: int
+    dst_channel_id: int
+    # The entity data message captured at prepare time — what an abort
+    # re-adds to the src cell. None for group members that carried no
+    # data (their "move" is removal-only, nothing to restore).
+    data: object
+    state: str = PREPARED
+
+
+class HandoverJournal:
+    """Transactional per-entity handover ledger (one in-flight record per
+    entity; a chained second hop overwrites the in-flight slot, and the
+    first hop's commit only clears the slot if it still owns it)."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        self._in_flight: dict[int, HandoverRecord] = {}
+        self._txn = 0
+        # entity id -> highest txn id whose commit flipped the placement
+        # ledger. Commits land in CHANNEL-TICK order, not txn order — a
+        # chained hop's commit can run before its predecessor's — so a
+        # flip is only granted to a txn newer than the last granted one.
+        self._flip_txn: dict[int, int] = {}
+        # Python-side ledger; must match handover_journal_total exactly.
+        self.counts: dict[str, int] = {}
+
+    def _count(self, state: str, n: int = 1) -> None:
+        self.counts[state] = self.counts.get(state, 0) + n
+        from . import metrics
+
+        metrics.handover_journal.labels(state=state).inc(n)
+
+    # ---- the transaction surface (called from grid orchestration) -------
+
+    def prepare(
+        self, entities: dict, src_channel_id: int, dst_channel_id: int
+    ) -> list[HandoverRecord]:
+        records = []
+        for entity_id, data in entities.items():
+            self._txn += 1
+            rec = HandoverRecord(
+                self._txn, entity_id, src_channel_id, dst_channel_id, data
+            )
+            self._in_flight[entity_id] = rec
+            records.append(rec)
+        self._count(PREPARED, len(records))
+        return records
+
+    def note_removed(self, records: list[HandoverRecord]) -> None:
+        """The src cell's remove ran (src tick). Aborted records stay
+        aborted — their restoring re-add is already queued behind this
+        very remove."""
+        for rec in records:
+            if rec.state == PREPARED:
+                rec.state = REMOVED
+
+    def commit(self, records: list[HandoverRecord]) -> list[int]:
+        """The dst cell's add ran (dst tick): the entity now lives in
+        exactly the dst cell. Returns the entity ids whose placement
+        ledger should flip to this txn's dst — txn-id ordered, so a
+        predecessor's late commit never clobbers a chained successor's
+        flip."""
+        committed = 0
+        flips: list[int] = []
+        for rec in records:
+            if rec.state in (PREPARED, REMOVED):
+                rec.state = COMMITTED
+                committed += 1
+                # Flip only on a REAL commit: an ABORTED record (entity
+                # destroyed mid-flight) must not resurrect a ledger row
+                # its cleanup already removed.
+                if self._flip_txn.get(rec.entity_id, 0) < rec.txn_id:
+                    self._flip_txn[rec.entity_id] = rec.txn_id
+                    flips.append(rec.entity_id)
+            if self._in_flight.get(rec.entity_id) is rec:
+                del self._in_flight[rec.entity_id]
+        if committed:
+            self._count(COMMITTED, committed)
+        return flips
+
+    def abort(self, rec: HandoverRecord) -> None:
+        if rec.state not in (COMMITTED, ABORTED):
+            rec.state = ABORTED
+            self._count(ABORTED)
+        if self._in_flight.get(rec.entity_id) is rec:
+            del self._in_flight[rec.entity_id]
+
+    # ---- queries ---------------------------------------------------------
+
+    def pending_dst(self, entity_id: int) -> Optional[int]:
+        """The dst channel id of the entity's in-flight handover, or
+        None. The batched detector consults this BEFORE the committed
+        placement ledger: mid-flight, the data is bound for the pending
+        dst even though ``_data_cell`` still says src."""
+        rec = self._in_flight.get(entity_id)
+        return rec.dst_channel_id if rec is not None else None
+
+    def in_flight_count(self) -> int:
+        return len(self._in_flight)
+
+    def forget_entity(self, entity_id: int) -> None:
+        """The entity was destroyed/untracked mid-flight: the transaction
+        is moot (nothing left to place)."""
+        self._flip_txn.pop(entity_id, None)
+        rec = self._in_flight.pop(entity_id, None)
+        if rec is not None and rec.state not in (COMMITTED, ABORTED):
+            rec.state = ABORTED
+            self._count(ABORTED)
+
+    # ---- failover resolution --------------------------------------------
+
+    def resolve_in_flight(self) -> list[HandoverRecord]:
+        """Deterministic crash resolution: a record whose dst channel can
+        never run its add (removed/missing) is aborted — the entity
+        belongs to the SRC cell. The restoring re-add is queued on the
+        src channel, so FIFO ordering guarantees it lands after any
+        still-pending remove regardless of which hop had executed when
+        the crash hit. Returns the aborted records (the caller re-offers
+        them after failover completes)."""
+        from .channel import get_channel
+
+        aborted = []
+        for entity_id, rec in list(self._in_flight.items()):
+            dst = get_channel(rec.dst_channel_id)
+            if dst is not None and not dst.is_removing():
+                continue  # the queued add still runs; commit will land
+            src = get_channel(rec.src_channel_id)
+            if (
+                src is not None
+                and not src.is_removing()
+                and rec.data is not None
+            ):
+                def _readd(ch, e=rec.entity_id, d=rec.data):
+                    adder = getattr(ch.get_data_message(), "add_entity", None)
+                    if adder is not None:
+                        adder(e, d)
+
+                src.execute(_readd)
+            self.abort(rec)
+            aborted.append(rec)
+            logger.warning(
+                "handover txn %d aborted: entity %d stays in cell %d "
+                "(dst %d is gone)",
+                rec.txn_id, entity_id, rec.src_channel_id,
+                rec.dst_channel_id,
+            )
+        return aborted
+
+    def report(self) -> dict:
+        return {
+            "counts": dict(self.counts),
+            "in_flight": self.in_flight_count(),
+        }
+
+
+# The process-wide journal; grid orchestration and the failover plane
+# share it (one attribute load on the handover hot path).
+journal = HandoverJournal()
+
+
+class FailoverPlane:
+    """ServerLostEvent -> cell re-hosting. One instance (``plane``),
+    (re-)installed by ``init_channels``."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        # Python-side re-host ledger; must match the prometheus counters.
+        self.ledger: dict[str, int] = {
+            "servers_lost": 0,
+            "cells_rehosted": 0,
+            "cells_unrehostable": 0,
+            "entities_repointed": 0,
+            "entities_stranded": 0,
+            "handovers_aborted": 0,
+        }
+        self.events: list[dict] = []  # one record per ServerLost, for soaks
+
+    def install(self) -> None:
+        from . import events
+
+        events.server_lost.unlisten_for(self)
+        events.server_lost.listen_for(self, self._on_server_lost)
+
+    # ---- event intake ----------------------------------------------------
+
+    def _on_server_lost(self, data) -> None:
+        self.ledger["servers_lost"] += 1
+        if not global_settings.failover_enabled:
+            logger.warning(
+                "failover disabled: server %s (conn %d) lost for good; its "
+                "%d owned channels stay ownerless",
+                data.pit, data.prev_conn_id, len(data.owned_channel_ids),
+            )
+            return
+        from .channel import get_global_channel
+
+        gch = get_global_channel()
+        if gch is None or gch.is_removing():
+            self._run(data)  # no runtime (tests): resolve inline
+        else:
+            # Channel state is single-writer; re-hosting touches many
+            # channels, so it runs where handover orchestration already
+            # does — inside the GLOBAL channel tick.
+            gch.execute(lambda _ch, d=data: self._run(d))
+
+    # ---- the failover pass (GLOBAL tick context) -------------------------
+
+    def _run(self, data) -> None:
+        from . import metrics
+        from .channel import all_channels, get_channel
+        from ..spatial.controller import get_spatial_controller
+
+        t0 = time.monotonic()
+        st = global_settings
+        ctl = get_spatial_controller()
+        spatial_lo = st.spatial_channel_id_start
+        spatial_hi = st.entity_channel_id_start
+
+        # In-flight handovers whose dst died with the server resolve to
+        # exactly one owning cell before any bootstrap is snapshotted.
+        aborted = journal.resolve_in_flight()
+        self.ledger["handovers_aborted"] += len(aborted)
+
+        orphan_cells = []
+        orphan_entities = []
+        for cid in data.owned_channel_ids:
+            ch = get_channel(cid)
+            if ch is None or ch.is_removing():
+                continue
+            if spatial_lo <= cid < spatial_hi and not ch.has_owner():
+                orphan_cells.append(cid)
+            elif cid >= spatial_hi:
+                orphan_entities.append(cid)
+
+        # Surviving spatial servers by load: owned-cell counts now, then
+        # incremented as orphans are assigned so one loss spreads evenly.
+        counts: dict = {}
+        for cid, ch in all_channels().items():
+            if spatial_lo <= cid < spatial_hi and ch.has_owner():
+                owner = ch.get_owner()
+                counts[owner] = counts.get(owner, 0) + 1
+        assignments: dict[int, object] = {}
+        if counts:
+            for cid in sorted(orphan_cells):
+                target = min(counts, key=lambda c: (counts[c], c.id))
+                counts[target] += 1
+                assignments[cid] = target
+        unrehostable = len(orphan_cells) - len(assignments)
+        if unrehostable:
+            self.ledger["cells_unrehostable"] += unrehostable
+            logger.error(
+                "no surviving spatial server: %d orphaned cells stay "
+                "ownerless (updates to them are counted in "
+                "ownerless_drops_total)", unrehostable,
+            )
+
+        # Orphaned entity channels re-point to the owner of the cell
+        # their data lives in (the committed placement ledger when a TPU
+        # controller runs; last-known position otherwise). The sweep
+        # covers the dead server's stash AND every other ownerless
+        # entity channel: a handover orchestrated INTO an orphaned cell
+        # during the recovery window stamps the entity with that cell's
+        # (dead) owner, and those channels appear in nobody's stash.
+        repointed: dict[int, list[int]] = {}
+        seen = set(orphan_entities)
+        sweep = list(orphan_entities)
+        for cid, ch in all_channels().items():
+            if cid >= spatial_hi and cid not in seen and not ch.has_owner():
+                sweep.append(cid)
+        for eid in sweep:
+            ech = get_channel(eid)
+            if ech is None or ech.is_removing() or ech.has_owner():
+                # Already re-owned by a live server (a handover landed
+                # it in a living cell during the window): leave it.
+                continue
+            cell_id = self._cell_of_entity(ctl, eid)
+            new_owner = assignments.get(cell_id)
+            if new_owner is None and cell_id is not None:
+                cell_ch = get_channel(cell_id)
+                if cell_ch is not None and cell_ch.has_owner():
+                    new_owner = cell_ch.get_owner()
+            if new_owner is None:
+                self.ledger["entities_stranded"] += 1
+                continue
+            self._repoint_entity(ech, new_owner)
+            self.ledger["entities_repointed"] += 1
+            if cell_id is not None:
+                repointed.setdefault(cell_id, []).append(eid)
+
+        for cid, target in assignments.items():
+            self._rehost_cell(
+                get_channel(cid), target, data.prev_conn_id,
+                sorted(repointed.get(cid, [])),
+            )
+
+        # Aborted handovers re-offer once failover is done: the entity
+        # re-orchestrates from its (restored) src cell to wherever its
+        # position now maps — through the normal batched detector.
+        for rec in aborted:
+            self._reoffer(ctl, rec)
+
+        elapsed_ms = (time.monotonic() - t0) * 1000.0
+        metrics.failover_rehost_ms.observe(elapsed_ms)
+        deadline_ms = st.failover_rehost_deadline_s * 1000.0
+        log = logger.warning if elapsed_ms > deadline_ms else logger.info
+        log(
+            "failover for %s (conn %d): %d/%d cells re-hosted, %d entity "
+            "channels re-pointed (%d stranded), %d in-flight handovers "
+            "aborted, %.1fms",
+            data.pit, data.prev_conn_id, len(assignments),
+            len(orphan_cells), sum(len(v) for v in repointed.values()),
+            self.ledger["entities_stranded"], len(aborted), elapsed_ms,
+        )
+        self.events.append({
+            "pit": data.pit,
+            "prev_conn_id": data.prev_conn_id,
+            "reason": data.reason,
+            "orphan_cells": sorted(orphan_cells),
+            "rehosted": {
+                str(cid): conn.id for cid, conn in assignments.items()
+            },
+            "entities_repointed": sum(len(v) for v in repointed.values()),
+            "handovers_aborted": len(aborted),
+            "duration_ms": round(elapsed_ms, 3),
+        })
+
+    # ---- pieces ----------------------------------------------------------
+
+    def _cell_of_entity(self, ctl, entity_id: int) -> Optional[int]:
+        if ctl is None:
+            return None
+        cell = getattr(ctl, "_data_cell", {}).get(entity_id)
+        if cell is not None:
+            return cell
+        info = getattr(ctl, "_last_positions", {}).get(entity_id)
+        if info is not None:
+            try:
+                return ctl.get_channel_id(info)
+            except ValueError:
+                return None
+        return None
+
+    def _repoint_entity(self, ech, new_owner) -> None:
+        from .subscription import subscribe_to_channel
+        from .subscription_messages import send_subscribed
+
+        ech.set_owner(new_owner)
+        # Full first fan-out on purpose: the entity channel's own state
+        # streams to the new authority (the cell bootstrap carries only
+        # the spatial data).
+        cs, should_send = subscribe_to_channel(new_owner, ech, None)
+        if should_send and cs is not None:
+            send_subscribed(new_owner, ech, new_owner, 0, cs.options)
+
+    def _rehost_cell(self, ch, new_owner, prev_conn_id, entity_ids) -> None:
+        from . import metrics
+        from ..protocol import control_pb2, spatial_pb2
+        from .message import MessageContext
+        from .snapshot import pack_channel_state
+        from .subscription import subscribe_to_channel
+        from .subscription_messages import send_subscribed
+
+        ch.set_owner(new_owner)
+        opts = control_pb2.ChannelSubscriptionOptions(
+            dataAccess=ChannelDataAccess.WRITE_ACCESS,
+            skipSelfUpdateFanOut=True,
+            # The authoritative bootstrap rides the CellRehostedMessage;
+            # a second full-state fan-out would be redundant bytes.
+            skipFirstFanOut=True,
+        )
+        cs, should_send = subscribe_to_channel(new_owner, ch, opts)
+        if should_send and cs is not None:
+            send_subscribed(new_owner, ch, new_owner, 0, cs.options)
+        self.ledger["cells_rehosted"] += 1
+        metrics.failover_rehost.inc()
+
+        def _announce(c, owner=new_owner, eids=list(entity_ids)):
+            # Serialized through the cell's own queue: any entity
+            # remove/add executes queued before the re-host land first,
+            # so the bootstrap snapshot reflects the resolved placement.
+            base = spatial_pb2.CellRehostedMessage(
+                channelId=c.id,
+                prevOwnerConnId=prev_conn_id,
+                newOwnerConnId=owner.id,
+                entityIds=eids,
+            )
+            boot = spatial_pb2.CellRehostedMessage()
+            boot.CopyFrom(base)
+            packed = pack_channel_state(c)
+            if packed is not None:
+                boot.channelData.CopyFrom(packed)
+            owner.send(MessageContext(
+                msg_type=MessageType.CELL_REHOSTED, msg=boot, channel_id=c.id,
+            ))
+            # Identifier-only copy for everyone else, encoded once; each
+            # remaining subscriber also gets a full-state resync (its
+            # delta stream is meaningless across an authority change).
+            shared = MessageContext(
+                msg_type=MessageType.CELL_REHOSTED, msg=base, channel_id=c.id,
+            )
+            shared.ensure_raw_body()
+            for conn, sub in list(c.subscribed_connections.items()):
+                if conn is owner or conn.is_closing():
+                    continue
+                conn.send(shared)
+                sub.fanout_conn.had_first_fanout = False
+
+        ch.execute(_announce)
+        # Device plane: the new owner's WRITE sub registered a fresh
+        # engine fan-out slot above (subscribe_to_channel); controllers
+        # keeping extra per-cell state get the explicit hook.
+        from ..spatial.controller import get_spatial_controller
+
+        ctl = get_spatial_controller()
+        hook = getattr(ctl, "on_cell_rehosted", None)
+        if hook is not None:
+            hook(ch.id, new_owner)
+
+    def _reoffer(self, ctl, rec: HandoverRecord) -> None:
+        """Queue an aborted handover for re-orchestration through the
+        batched detector (TPU controller) once failover completed."""
+        if ctl is None:
+            return
+        deferred = getattr(ctl, "_deferred_crossings", None)
+        if deferred is None or rec.entity_id in deferred:
+            return
+        last = getattr(ctl, "_last_positions", {}).get(rec.entity_id)
+        start = global_settings.spatial_channel_id_start
+        try:
+            old_info = ctl._cell_center(rec.src_channel_id - start)
+        except AttributeError:
+            return
+        provider = getattr(ctl, "_providers", {}).get(
+            rec.entity_id, lambda s, d, e=rec.entity_id: e
+        )
+        deferred[rec.entity_id] = (old_info, last or old_info, provider)
+
+    def report(self) -> dict:
+        return {
+            "ledger": dict(self.ledger),
+            "events": list(self.events),
+            "journal": journal.report(),
+        }
+
+
+plane = FailoverPlane()
+
+
+def reset_failover() -> None:
+    """Test hook (also run by init_channels at world boot)."""
+    journal.reset()
+    plane.reset()

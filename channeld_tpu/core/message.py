@@ -17,7 +17,7 @@ from google.protobuf.message import Message
 
 from ..protocol import MESSAGE_TEMPLATES, control_pb2, wire_pb2
 from ..utils.logger import get_logger, security_logger
-from . import events
+from . import events, metrics
 from .acl import ChannelAccessType, check_acl
 from .auth import AuthResult, get_auth_provider, run_auth
 from .data import unwrap_update_any
@@ -142,6 +142,13 @@ def handle_client_to_server_user_message(ctx: MessageContext) -> None:
                 "illegal client broadcast attempt on channel %d", ctx.channel.id
             )
     else:
+        # Ownerless drop: counted whether the owner might still come back
+        # (recovery window open) or is gone for good — a sustained rate
+        # after failover should have run is the operator's alarm
+        # (doc/failover.md).
+        metrics.ownerless_drops.labels(
+            channel_type=ctx.channel.channel_type.name
+        ).inc()
         if not ctx.channel.recoverable_subs:
             # Once per second per channel: every in-flight client message
             # hits this line the moment an owner drops, and per-message

@@ -122,6 +122,46 @@ sidecar_call_retries = Counter(
     registry=registry,
 )
 
+# Failover plane (core/failover.py; doc/failover.md).
+ownerless_drops = Counter(
+    "ownerless_drops",
+    "Updates dropped because the target channel has no owner connection "
+    "(previously only a rate-limited warn log); a sustained non-zero rate "
+    "on SPATIAL/ENTITY channels means a dead server's cells were never "
+    "re-hosted",
+    ["channel_type"],
+    registry=registry,
+)
+server_lost = Counter(
+    "server_lost",
+    "Recoverable server connections declared dead for good (recovery "
+    "window expired or handle evicted); one ServerLostEvent fires per "
+    "increment",
+    registry=registry,
+)
+failover_rehost = Counter(
+    "failover_rehost",
+    "Orphaned spatial cells re-hosted onto surviving servers after a "
+    "permanent server loss",
+    registry=registry,
+)
+failover_rehost_ms = Histogram(
+    "failover_rehost_ms",
+    "Duration of one failover pass (ServerLostEvent -> every orphaned "
+    "cell re-hosted and every orphaned entity channel re-pointed), "
+    "milliseconds",
+    buckets=(0.5, 1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0, 500.0),
+    registry=registry,
+)
+handover_journal = Counter(
+    "handover_journal",
+    "Transactional handover-journal records by terminal state "
+    "(prepared == committed + aborted once the gateway quiesces; the "
+    "python-side ledger in core/failover.py must match exactly)",
+    ["state"],
+    registry=registry,
+)
+
 # Overload-control plane (core/overload.py; doc/overload.md).
 overload_level = Gauge(
     "overload_level",

@@ -523,19 +523,11 @@ async def run_server(argv: Optional[list[str]] = None) -> None:
         tasks.append(asyncio.ensure_future(connection_recovery_loop()))
 
     if global_settings.snapshot_path:
-        import os
+        from .snapshot import boot_restore, snapshot_loop
 
-        from .snapshot import restore_snapshot, snapshot_loop
-
-        if os.path.exists(global_settings.snapshot_path):
-            try:
-                restore_snapshot(global_settings.snapshot_path)
-            except Exception:
-                # A corrupt snapshot must never block boot; start fresh.
-                logger.exception(
-                    "failed to restore snapshot %s; starting with an empty "
-                    "topology", global_settings.snapshot_path,
-                )
+        # Restore-at-boot (corrupt/missing files never block boot), then
+        # the periodic fsync-then-rename writer on -snapshot-interval.
+        boot_restore(global_settings.snapshot_path)
         tasks.append(asyncio.ensure_future(snapshot_loop(
             global_settings.snapshot_path, global_settings.snapshot_interval_s
         )))

@@ -160,6 +160,15 @@ class GlobalSettings:
     overload_handover_batch_cap: int = 256  # crossings/tick at L2+
     overload_retry_after_ms: int = 2000  # ServerBusyMessage back-off
 
+    # Spatial authority failover (new — doc/failover.md). When a
+    # recoverable server's recovery window expires for good, its
+    # orphaned spatial cells are re-hosted onto surviving servers
+    # (fewest-owned-cells first) instead of going dark. The deadline is
+    # the operator's bound on one failover pass; overruns only warn —
+    # a slow re-host still beats a dead cell.
+    failover_enabled: bool = True
+    failover_rehost_deadline_s: float = 5.0
+
     # Device mesh for the spatial engine: 0 devices = single-device step;
     # N>0 shards the entity arrays over the first N jax devices, and
     # hosts>1 arranges them as a (hosts, chips) DCN x ICI mesh — the TPU
@@ -270,6 +279,17 @@ class GlobalSettings:
                        default=self.overload_down_hold_s,
                        help="seconds the pressure must hold under the exit "
                             "threshold before the ladder steps down")
+        p.add_argument("-failover",
+                       type=lambda s: s.lower() not in
+                       ("false", "0", "no", "off"),
+                       default=self.failover_enabled,
+                       help="re-host a dead server's spatial cells onto "
+                            "surviving servers (doc/failover.md); false "
+                            "leaves them ownerless")
+        p.add_argument("-failover-deadline", type=float,
+                       default=self.failover_rehost_deadline_s,
+                       help="seconds one failover pass may take before "
+                            "the overrun is logged as a warning")
         p.add_argument("-mesh-devices", type=int, default=self.tpu_mesh_devices,
                        help="shard the spatial engine over N devices "
                             "(0 = single-device step)")
@@ -310,6 +330,8 @@ class GlobalSettings:
         self.overload_enabled = args.overload
         self.overload_retry_after_ms = args.overload_retry_after
         self.overload_down_hold_s = args.overload_down_hold
+        self.failover_enabled = args.failover
+        self.failover_rehost_deadline_s = args.failover_deadline
         self.spatial_backend = args.spatial_backend
         self.tpu_mesh_devices = args.mesh_devices
         self.tpu_mesh_hosts = args.mesh_hosts

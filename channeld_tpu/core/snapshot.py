@@ -23,6 +23,17 @@ from .types import ChannelType, GLOBAL_CHANNEL_ID
 logger = get_logger("snapshot")
 
 
+def pack_channel_state(ch):
+    """One channel's authoritative data as a packed Any, or None when the
+    channel holds no data. The single pack path shared by snapshots and
+    by the failover plane's cell-bootstrap stream (core/failover.py) —
+    what a restored gateway would serve and what a re-hosted cell's new
+    owner receives are byte-identical by construction."""
+    if ch.data is None or ch.data.msg is None:
+        return None
+    return pack_any(ch.data.msg)
+
+
 def take_snapshot() -> snapshot_pb2.GatewaySnapshot:
     from .channel import all_channels
 
@@ -33,22 +44,31 @@ def take_snapshot() -> snapshot_pb2.GatewaySnapshot:
         entry = snap.channels.add(
             channelId=ch.id, channelType=ch.channel_type, metadata=ch.metadata
         )
-        if ch.data is not None and ch.data.msg is not None:
-            entry.data.CopyFrom(pack_any(ch.data.msg))
+        packed = pack_channel_state(ch)
+        if packed is not None:
+            entry.data.CopyFrom(packed)
             if ch.data.merge_options is not None:
                 entry.mergeOptions.CopyFrom(ch.data.merge_options)
     return snap
 
 
-def save_snapshot(path: str) -> str:
+def write_snapshot(snap: snapshot_pb2.GatewaySnapshot, path: str) -> str:
+    """Durable write: tmp file, fsync, then atomic rename — a crash at
+    any point leaves either the old snapshot or the new one, never a
+    torn file. Shared by the one-shot save and the periodic loop."""
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    snap = take_snapshot()
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         f.write(snap.SerializeToString())
         f.flush()
         os.fsync(f.fileno())  # data durable before the rename lands
     os.replace(tmp, path)  # atomic
+    return path
+
+
+def save_snapshot(path: str) -> str:
+    snap = take_snapshot()
+    write_snapshot(snap, path)
     logger.info("saved snapshot of %d channels to %s", len(snap.channels), path)
     return path
 
@@ -88,8 +108,26 @@ def restore_snapshot(path: str) -> int:
     return restored
 
 
+def boot_restore(path: str) -> int:
+    """The boot-time restore step behind the ``-snapshot`` flag: restore
+    when a snapshot exists, start fresh when it doesn't, and never let a
+    corrupt file block boot. Returns the number of channels restored
+    (0 = fresh start). Must run after init_channels."""
+    if not os.path.exists(path):
+        return 0
+    try:
+        return restore_snapshot(path)
+    except Exception:
+        logger.exception(
+            "failed to restore snapshot %s; starting with an empty "
+            "topology", path,
+        )
+        return 0
+
+
 async def snapshot_loop(path: str, interval_s: float = 30.0) -> None:
-    """Periodic snapshot writer."""
+    """Periodic snapshot writer (scheduled by run_server when the
+    ``-snapshot`` flag names a path; cadence from ``-snapshot-interval``)."""
     import asyncio
 
     while True:
@@ -99,16 +137,7 @@ async def snapshot_loop(path: str, interval_s: float = 30.0) -> None:
             # the serialization + fsync'd write offloads to a thread so
             # ticks/flushes never stall behind disk IO.
             snap = take_snapshot()
-
-            def _write(snap=snap):
-                tmp = path + ".tmp"
-                with open(tmp, "wb") as f:
-                    f.write(snap.SerializeToString())
-                    f.flush()
-                    os.fsync(f.fileno())
-                os.replace(tmp, path)
-
-            await asyncio.to_thread(_write)
+            await asyncio.to_thread(write_snapshot, snap, path)
             logger.info(
                 "saved snapshot of %d channels to %s", len(snap.channels), path
             )

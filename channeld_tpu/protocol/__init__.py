@@ -39,6 +39,7 @@ MESSAGE_TEMPLATES = {
     22: control_pb2.ChannelOwnerLostMessage,
     23: control_pb2.ChannelOwnerRecoveredMessage,
     24: control_pb2.ServerBusyMessage,
+    25: spatial_pb2.CellRehostedMessage,
     99: spatial_pb2.DebugGetSpatialRegionsMessage,
 }
 

@@ -501,7 +501,21 @@ class StaticGrid2DSpatialController:
                 entity_ch.set_owner(dst_channel.get_owner())
 
         # Step 2: move the entities between the spatial channels' data,
-        # each inside its own channel's execution context.
+        # each inside its own channel's execution context — wrapped in a
+        # transactional journal (core/failover.py): prepare here, the
+        # remove marks the src hop done, the dst's add COMMITS. A crash
+        # between the hops resolves deterministically to exactly one
+        # owning cell (the failover pass aborts records whose dst can
+        # never run and re-adds the data to src through the same FIFO
+        # queue), and the authoritative placement ledger only flips on
+        # commit — never on an optimistic queue.
+        from ..core.failover import journal as _journal
+
+        records = _journal.prepare(
+            handover_entities, src_channel_id, dst_channel_id
+        )
+        moved_hook = getattr(self, "_note_entity_data_moved", None)
+
         def _remove(ch):
             data_msg = ch.get_data_message()
             remover = getattr(data_msg, "remove_entity", None)
@@ -510,28 +524,33 @@ class StaticGrid2DSpatialController:
                 return
             for entity_id in handover_entities:
                 remover(entity_id)
+            _journal.note_removed(records)
 
         def _add(ch):
             data_msg = ch.get_data_message()
             adder = getattr(data_msg, "add_entity", None)
             if adder is None:
                 ch.logger.warning("spatial data can't add entities")
+                for rec in records:
+                    _journal.abort(rec)
                 return
             for entity_id, entity_data in handover_entities.items():
                 if entity_data is not None:
                     adder(entity_id, entity_data)
+            flips = _journal.commit(records)
+            # Placement hook: the move is now REAL (the add ran in the
+            # dst tick). Controllers keeping an authoritative placement
+            # ledger (the TPU controller's _data_cell, which
+            # de-duplicates stale engine re-detections) flip it here —
+            # never on a skipped orchestration or an in-flight one, and
+            # only for entities whose flip the journal granted (commits
+            # land in channel-tick order; a chained hop may have
+            # committed first).
+            if moved_hook is not None and flips:
+                moved_hook(flips, dst_channel_id)
 
         src_channel.execute(_remove)
         dst_channel.execute(_add)
-        # Placement hook: the entity data's move is now committed (both
-        # executes are queued FIFO in their channels). Controllers that
-        # keep an authoritative placement ledger (the TPU controller's
-        # _data_cell, which de-duplicates stale engine re-detections)
-        # update it HERE — after the move is real, never on a skipped
-        # orchestration (missing entity channel, locked group, ...).
-        moved_hook = getattr(self, "_note_entity_data_moved", None)
-        if moved_hook is not None:
-            moved_hook(list(handover_entities), dst_channel_id)
 
         # Step 3: identifier-only handover payload for src-side connections.
         spatial_data_msg = reflect_channel_data_message(ChannelType.SPATIAL)

@@ -24,6 +24,7 @@ from __future__ import annotations
 from typing import Callable, Optional
 
 from ..chaos.injector import chaos as _chaos
+from ..core.failover import journal as _journal
 from ..core.overload import governor as _governor
 from ..core.settings import global_settings
 from ..utils.logger import get_logger
@@ -275,6 +276,29 @@ class TPUSpatialController(StaticGrid2DSpatialController):
         self._providers.pop(entity_id, None)
         self._deferred_crossings.pop(entity_id, None)
         self._data_cell.pop(entity_id, None)
+        # A destroyed entity's in-flight handover is moot.
+        _journal.forget_entity(entity_id)
+
+    def on_cell_rehosted(self, cell_channel_id: int, new_owner) -> None:
+        """Failover hook (core/failover.py): the cell's authority moved
+        to ``new_owner``. Nothing re-shards on device — the cells-plane
+        cell->shard placement is geometric, and the new owner's WRITE
+        subscription already registered a fresh engine fan-out slot.
+        What must stay exact is the placement ledger: re-seed a row for
+        every entity actually resident in the cell's authoritative data
+        (an entity shed/re-tracked during the outage can have lost its
+        row, and a later crossing orchestrated from the wrong origin
+        would leave its data duplicated across two cells)."""
+        from ..core.channel import get_channel
+
+        ch = get_channel(cell_channel_id)
+        if ch is None:
+            return
+        entities = getattr(ch.get_data_message(), "entities", None)
+        if entities is None:
+            return
+        for eid in entities:
+            self._data_cell.setdefault(eid, cell_channel_id)
 
     # ---- device fan-out plane --------------------------------------------
 
@@ -474,6 +498,23 @@ class TPUSpatialController(StaticGrid2DSpatialController):
                     pending[e] = (prev[0], new_info, provider)
                     continue
                 old_info, new_info, provider = self._build_crossing(e, s, d)
+                # The transactional journal outranks the committed
+                # ledger: mid-flight, the entity's data is bound for the
+                # pending dst even though _data_cell still says src
+                # (it only flips on commit, in the dst cell's tick).
+                pend_dst = _journal.pending_dst(e)
+                if pend_dst is not None:
+                    if pend_dst == start_id + d:
+                        # Stale re-detection of the in-flight move.
+                        continue
+                    # Chained hop: orchestrate from where the in-flight
+                    # txn will land (FIFO on that channel's queue puts
+                    # the new remove after the pending add).
+                    pending[e] = (
+                        self._cell_center(pend_dst - start_id),
+                        new_info, provider,
+                    )
+                    continue
                 known = self._data_cell.get(e)
                 if known is not None:
                     if known == start_id + d:
