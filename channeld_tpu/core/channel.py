@@ -475,6 +475,12 @@ class Channel:
         elapsed = time.monotonic() - tick_start
         self._m_tick_duration.observe(elapsed)
         _governor.note_tick(elapsed, self.tick_interval)
+        if self.channel_type == ChannelType.SPATIAL:
+            # Per-server load attribution for the balancer: this cell's
+            # tick cost lands on its owner server's pressure ledger.
+            owner = self.owner_connection
+            if owner is not None:
+                _governor.note_server_cost(owner.id, elapsed)
         if self.channel_type == ChannelType.GLOBAL:
             _governor.update(self.tick_interval)
 
@@ -680,6 +686,12 @@ def init_channels() -> None:
 
     reset_failover()
     plane.install()
+    # Same for the load balancer: fresh ledgers + the server-registration
+    # orphan-adoption listener (doc/balancer.md).
+    from ..spatial.balancer import balancer, reset_balancer
+
+    reset_balancer()
+    balancer.install()
     _non_spatial_alloc = IdAllocator(1, global_settings.spatial_channel_id_start - 1)
     _spatial_alloc = IdAllocator(
         global_settings.spatial_channel_id_start,

@@ -25,7 +25,7 @@ from ..utils.anyutil import pack_any, unpack_any
 from ..utils.fieldmask import filter_fields
 from ..utils.logger import get_logger
 from .overload import governor as _governor
-from .types import ChannelDataAccess, MessageType
+from .types import ChannelDataAccess, ChannelType, MessageType
 
 if TYPE_CHECKING:
     from .channel import Channel
@@ -33,6 +33,22 @@ if TYPE_CHECKING:
 logger = get_logger("data")
 
 MAX_UPDATE_MSG_BUFFER_SIZE = 512
+
+# Balancer handle bound lazily (core must not import the spatial package
+# at module load).
+_balancer = None
+
+
+def _note_spatial_fanout(channel, nbytes: int) -> None:
+    """Feed the balancer's per-cell fan-out byte signal (SPATIAL
+    channels only — entity/global fan-out is attributed via entity
+    counts and server pressure instead)."""
+    global _balancer
+    if _balancer is None:
+        from ..spatial.balancer import balancer as _balancer_mod
+
+        _balancer = _balancer_mod
+    _balancer.note_fanout_bytes(channel.id, nbytes)
 
 # channel-type -> protobuf template for reflection-created channel data
 # (ref: data.go:62 RegisterChannelDataType).
@@ -465,8 +481,11 @@ def fan_out_data_update(
         body_cache = None  # per-subscriber content
     from .message import MessageContext  # local: message imports data
 
+    spatial = channel.channel_type == ChannelType.SPATIAL
     hit = body_cache.get(id(update_msg)) if body_cache is not None else None
     if hit is not None:
+        if spatial and hit[1].raw_body is not None:
+            _note_spatial_fanout(channel, len(hit[1].raw_body))
         conn.send(hit[1])
         return
     ctx = MessageContext(
@@ -476,6 +495,8 @@ def fan_out_data_update(
         channel_id=channel.id,
     )
     ctx.ensure_raw_body()
+    if spatial and ctx.raw_body is not None:
+        _note_spatial_fanout(channel, len(ctx.raw_body))
     if body_cache is not None:
         # The queued sender consumes the context immediately (tuple into
         # the send queue), so one context object serves every recipient.

@@ -60,6 +60,94 @@ COMMITTED = "committed"
 ABORTED = "aborted"
 
 
+def placement_score(cells_owned: int, entities_hosted: int) -> float:
+    """Entity-weighted placement load of one candidate server — the ONE
+    scoring function shared by failover re-host and the live balancer
+    (spatial/balancer.py). Lower is better. Owned-cell count alone (the
+    old failover rule) mis-ranks a server with few but HUGE cells as
+    idle; entities are the actual per-tick cost driver, so they weigh
+    in at ``failover_placement_entity_weight`` cells each."""
+    return (
+        cells_owned
+        + entities_hosted * global_settings.failover_placement_entity_weight
+    )
+
+
+def entity_count_of(ch) -> int:
+    """Entities resident in one channel's authoritative data (0 when the
+    data type has no entity table)."""
+    if ch is None or ch.data is None:
+        return 0
+    ents = getattr(ch.data.msg, "entities", None)
+    return len(ents) if ents is not None else 0
+
+
+def collect_spatial_loads() -> dict:
+    """conn -> [cells_owned, entities_hosted] over every live-owned
+    spatial cell — the candidate table both placement consumers feed
+    into :func:`placement_score`."""
+    from .channel import all_channels
+
+    lo = global_settings.spatial_channel_id_start
+    hi = global_settings.entity_channel_id_start
+    loads: dict = {}
+    for cid, ch in all_channels().items():
+        if lo <= cid < hi and ch.has_owner():
+            row = loads.setdefault(ch.get_owner(), [0, 0])
+            row[0] += 1
+            row[1] += entity_count_of(ch)
+    return loads
+
+
+def pick_placement(loads: dict):
+    """The candidate with the lowest entity-weighted placement score,
+    tie-break lowest conn id; None when there are no candidates. The
+    caller mutates ``loads`` between picks so one loss/migration wave
+    spreads evenly."""
+    if not loads:
+        return None
+    return min(
+        loads,
+        key=lambda c: (placement_score(loads[c][0], loads[c][1]), c.id),
+    )
+
+
+def announce_authority_change(ch, new_owner, msg_type, build_msg) -> None:
+    """The ONE announce path for a cell authority change, shared by
+    failover re-host (CellRehostedMessage) and planned migration
+    (CellMigratedMessage). Serialized through the cell's own queue so
+    any queued entity remove/add lands before the bootstrap snapshot is
+    taken: the new owner's copy carries the packed authoritative state
+    (the snapshot pack path); every other subscriber gets the
+    identifier-only copy — encoded once, shared — plus a forced
+    full-state resync (a delta stream is void across an authority
+    change)."""
+    from .message import MessageContext
+    from .snapshot import pack_channel_state
+
+    def _announce(c, owner=new_owner):
+        base = build_msg(c)
+        boot = type(base)()
+        boot.CopyFrom(base)
+        packed = pack_channel_state(c)
+        if packed is not None:
+            boot.channelData.CopyFrom(packed)
+        owner.send(MessageContext(
+            msg_type=msg_type, msg=boot, channel_id=c.id,
+        ))
+        shared = MessageContext(
+            msg_type=msg_type, msg=base, channel_id=c.id,
+        )
+        shared.ensure_raw_body()
+        for conn, sub in list(c.subscribed_connections.items()):
+            if conn is owner or conn.is_closing():
+                continue
+            conn.send(shared)
+            sub.fanout_conn.had_first_fanout = False
+
+    ch.execute(_announce)
+
+
 @dataclass
 class HandoverRecord:
     txn_id: int
@@ -165,6 +253,17 @@ class HandoverJournal:
 
     def in_flight_count(self) -> int:
         return len(self._in_flight)
+
+    def in_flight_touching(self, channel_id: int) -> int:
+        """In-flight handover records reading or writing one spatial
+        channel — the balancer's drain barrier: a cell migration only
+        executes once no transaction still references the cell."""
+        return sum(
+            1
+            for rec in self._in_flight.values()
+            if rec.src_channel_id == channel_id
+            or rec.dst_channel_id == channel_id
+        )
 
     def forget_entity(self, entity_id: int) -> None:
         """The entity was destroyed/untracked mid-flight: the transaction
@@ -302,18 +401,17 @@ class FailoverPlane:
             elif cid >= spatial_hi:
                 orphan_entities.append(cid)
 
-        # Surviving spatial servers by load: owned-cell counts now, then
-        # incremented as orphans are assigned so one loss spreads evenly.
-        counts: dict = {}
-        for cid, ch in all_channels().items():
-            if spatial_lo <= cid < spatial_hi and ch.has_owner():
-                owner = ch.get_owner()
-                counts[owner] = counts.get(owner, 0) + 1
+        # Surviving spatial servers by entity-weighted load (the shared
+        # placement_score), updated as orphans are assigned so one loss
+        # spreads evenly — an entity-heavy server is deprioritized even
+        # when it owns few cells.
+        loads = collect_spatial_loads()
         assignments: dict[int, object] = {}
-        if counts:
+        if loads:
             for cid in sorted(orphan_cells):
-                target = min(counts, key=lambda c: (counts[c], c.id))
-                counts[target] += 1
+                target = pick_placement(loads)
+                loads[target][0] += 1
+                loads[target][1] += entity_count_of(get_channel(cid))
                 assignments[cid] = target
         unrehostable = len(orphan_cells) - len(assignments)
         if unrehostable:
@@ -425,8 +523,6 @@ class FailoverPlane:
     def _rehost_cell(self, ch, new_owner, prev_conn_id, entity_ids) -> None:
         from . import metrics
         from ..protocol import control_pb2, spatial_pb2
-        from .message import MessageContext
-        from .snapshot import pack_channel_state
         from .subscription import subscribe_to_channel
         from .subscription_messages import send_subscribed
 
@@ -444,38 +540,15 @@ class FailoverPlane:
         self.ledger["cells_rehosted"] += 1
         metrics.failover_rehost.inc()
 
-        def _announce(c, owner=new_owner, eids=list(entity_ids)):
-            # Serialized through the cell's own queue: any entity
-            # remove/add executes queued before the re-host land first,
-            # so the bootstrap snapshot reflects the resolved placement.
-            base = spatial_pb2.CellRehostedMessage(
+        announce_authority_change(
+            ch, new_owner, MessageType.CELL_REHOSTED,
+            lambda c, eids=list(entity_ids): spatial_pb2.CellRehostedMessage(
                 channelId=c.id,
                 prevOwnerConnId=prev_conn_id,
-                newOwnerConnId=owner.id,
+                newOwnerConnId=new_owner.id,
                 entityIds=eids,
-            )
-            boot = spatial_pb2.CellRehostedMessage()
-            boot.CopyFrom(base)
-            packed = pack_channel_state(c)
-            if packed is not None:
-                boot.channelData.CopyFrom(packed)
-            owner.send(MessageContext(
-                msg_type=MessageType.CELL_REHOSTED, msg=boot, channel_id=c.id,
-            ))
-            # Identifier-only copy for everyone else, encoded once; each
-            # remaining subscriber also gets a full-state resync (its
-            # delta stream is meaningless across an authority change).
-            shared = MessageContext(
-                msg_type=MessageType.CELL_REHOSTED, msg=base, channel_id=c.id,
-            )
-            shared.ensure_raw_body()
-            for conn, sub in list(c.subscribed_connections.items()):
-                if conn is owner or conn.is_closing():
-                    continue
-                conn.send(shared)
-                sub.fanout_conn.had_first_fanout = False
-
-        ch.execute(_announce)
+            ),
+        )
         # Device plane: the new owner's WRITE sub registered a fresh
         # engine fan-out slot above (subscribe_to_channel); controllers
         # keeping extra per-cell state get the explicit hook.

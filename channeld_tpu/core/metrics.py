@@ -162,6 +162,49 @@ handover_journal = Counter(
     registry=registry,
 )
 
+# Live spatial load balancer (spatial/balancer.py; doc/balancer.md).
+spatial_cell_entities = Gauge(
+    "spatial_cell_entities",
+    "Entities resident in one spatial cell's authoritative data "
+    "(sampled once per GLOBAL tick by the balancer's load pass)",
+    ["cell"],
+    registry=registry,
+)
+spatial_cell_crossings = Counter(
+    "spatial_cell_crossings",
+    "Entity handovers orchestrated touching one spatial cell "
+    "(direction=out: the cell was the crossing's src; direction=in: its "
+    "dst) — the balancer's crossing-rate signal, fed from the tick "
+    "loop's handover orchestration",
+    ["cell", "direction"],
+    registry=registry,
+)
+balancer_migrations = Counter(
+    "balancer_migrations",
+    "Planned live-cell migrations by terminal result (committed: owner "
+    "flipped, zero loss; aborted: deterministic rollback to the old "
+    "owner — dst died, drain timed out, overload outranked, or the "
+    "world changed underneath; vetoed: never planned because the "
+    "destination or the gateway sat at overload L2+; python ledger in "
+    "spatial/balancer.py must match exactly)",
+    ["result"],
+    registry=registry,
+)
+balancer_migration_ms = Histogram(
+    "balancer_migration_ms",
+    "Duration of one planned cell migration, freeze -> commit/abort, "
+    "milliseconds (includes the crossing-drain window)",
+    buckets=(5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0, 5000.0),
+    registry=registry,
+)
+balancer_imbalance = Gauge(
+    "balancer_imbalance",
+    "Per-server load imbalance (max/mean of the entity+crossing+bytes+"
+    "pressure fold; 1.0 == perfectly even; the balancer plans a "
+    "migration when this holds above the enter threshold)",
+    registry=registry,
+)
+
 # Overload-control plane (core/overload.py; doc/overload.md).
 overload_level = Gauge(
     "overload_level",

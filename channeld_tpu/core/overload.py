@@ -97,6 +97,14 @@ class OverloadGovernor:
         self._worst_util = 0.0
         self._handover_cost_s = 0.0
         self._follower_cost_s = 0.0
+        # Per-server pressure export (consumed by the spatial load
+        # balancer, spatial/balancer.py): owner conn id -> EWMA of the
+        # tick cost of the spatial channels that server owns, as a
+        # fraction of the GLOBAL tick budget. The gateway-wide ladder
+        # stays the weakest-link signal; this is the attribution the
+        # balancer needs to tell a hot SERVER from a hot gateway.
+        self.server_pressure: dict[int, float] = {}
+        self._server_cost_s: dict[int, float] = {}
         self._up_ticks = 0
         self._down_since: Optional[float] = None
         self._last_down_at = -1e9  # anti-flap cooldown anchor
@@ -119,10 +127,41 @@ class OverloadGovernor:
     def note_follower_cost(self, seconds: float) -> None:
         self._follower_cost_s += seconds
 
+    def note_server_cost(self, owner_conn_id: int, seconds: float) -> None:
+        """One owned spatial channel's tick cost, attributed to its
+        owner server (fed from Channel.tick_once)."""
+        acc = self._server_cost_s
+        acc[owner_conn_id] = acc.get(owner_conn_id, 0.0) + seconds
+
+    def server_pressure_of(self, conn_id: int) -> float:
+        return self.server_pressure.get(conn_id, 0.0)
+
+    def _fold_server_pressure(self, interval: float, alpha: float) -> None:
+        """EWMA the per-server cost accumulators (always runs, even with
+        the ladder disabled — the balancer reads this attribution
+        whether or not degradation is armed). Idle servers decay toward
+        zero and are dropped once negligible."""
+        cost = self._server_cost_s
+        pressure = self.server_pressure
+        for cid in list(pressure):
+            raw = cost.pop(cid, 0.0) / interval
+            nxt = alpha * raw + (1.0 - alpha) * pressure[cid]
+            if nxt < 1e-4:
+                del pressure[cid]
+            else:
+                pressure[cid] = nxt
+        for cid, s in cost.items():
+            pressure[cid] = alpha * (s / interval)
+        cost.clear()
+
     # ---- the update (once per GLOBAL tick) -------------------------------
 
     def update(self, interval_s: float) -> None:
-        if not global_settings.overload_enabled:
+        st0 = global_settings
+        self._fold_server_pressure(
+            interval_s if interval_s > 0 else 0.010, st0.overload_alpha
+        )
+        if not st0.overload_enabled:
             if self.level:
                 self._move(OverloadLevel.L0, forced=True)
             return

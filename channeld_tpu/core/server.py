@@ -488,6 +488,17 @@ async def run_server(argv: Optional[list[str]] = None) -> None:
             global_settings.overload_exit_thresholds,
             global_settings.overload_retry_after_ms,
         )
+    if global_settings.balancer_enabled:
+        logger.info(
+            "spatial load balancer armed: imbalance enter=%.2f exit=%.2f, "
+            "budget %d/epoch (%d ticks), cooldown %d ticks "
+            "(doc/balancer.md)",
+            global_settings.balancer_imbalance_enter,
+            global_settings.balancer_imbalance_exit,
+            global_settings.balancer_budget_per_epoch,
+            global_settings.balancer_epoch_ticks,
+            global_settings.balancer_cooldown_ticks,
+        )
 
     # Fail boot on a missing auth provider outside development: raising at
     # auth time would be swallowed by the per-message isolator and the

@@ -168,6 +168,45 @@ class GlobalSettings:
     # a slow re-host still beats a dead cell.
     failover_enabled: bool = True
     failover_rehost_deadline_s: float = 5.0
+    # Entity weight in the shared placement score (core/failover.py
+    # placement_score, used by failover re-host AND the balancer): one
+    # hosted entity costs this many owned cells — a server with few but
+    # huge cells is no longer mis-ranked as idle.
+    failover_placement_entity_weight: float = 0.0625
+
+    # Live spatial load balancer (new — doc/balancer.md). Planned,
+    # zero-loss migration of live cells between live servers: the
+    # balancer folds per-server load (entities, crossing rate, fan-out
+    # bytes, overload pressure) into an imbalance score (max/mean) with
+    # two-sided hysteresis, a per-epoch migration budget and a per-cell
+    # post-migration cooldown so it never flaps and never fights the
+    # overload ladder (migrations are vetoed at L2+).
+    balancer_enabled: bool = True
+    balancer_imbalance_enter: float = 1.6
+    balancer_imbalance_exit: float = 1.25
+    balancer_hold_ticks: int = 5  # consecutive over-threshold updates
+    balancer_epoch_ticks: int = 300  # GLOBAL ticks per migration epoch
+    balancer_budget_per_epoch: int = 2  # committed migrations per epoch
+    balancer_cooldown_ticks: int = 600  # per-cell re-migration lockout
+    # Hottest-coldest per-server entity gap below which the world is too
+    # small to be worth migrating (keeps tiny test worlds untouched).
+    balancer_min_entity_delta: int = 8
+    # Freeze-phase bounds, in GLOBAL ticks: at least min (queued entity
+    # hops on the cell channel must run before the bootstrap snapshot),
+    # at most the drain deadline (a journal that never clears aborts the
+    # migration back to the old owner).
+    balancer_freeze_min_ticks: int = 2
+    balancer_drain_deadline_ticks: int = 120
+    # Load-fold weights: one crossing per update == this many entities;
+    # one KiB of fan-out per update == this many; one unit of per-server
+    # overload pressure == this many.
+    balancer_crossing_weight: float = 2.0
+    balancer_bytes_weight: float = 0.5
+    balancer_pressure_weight: float = 32.0
+    # Per-destination veto: a candidate whose exported overload pressure
+    # is at/above this never receives a migration (the gateway-wide
+    # ladder at L2+ vetoes ALL migrations regardless).
+    balancer_dest_pressure_max: float = 1.15
 
     # Device mesh for the spatial engine: 0 devices = single-device step;
     # N>0 shards the entity arrays over the first N jax devices, and
@@ -290,6 +329,29 @@ class GlobalSettings:
                        default=self.failover_rehost_deadline_s,
                        help="seconds one failover pass may take before "
                             "the overrun is logged as a warning")
+        p.add_argument("-balancer",
+                       type=lambda s: s.lower() not in
+                       ("false", "0", "no", "off"),
+                       default=self.balancer_enabled,
+                       help="live spatial load balancer: planned "
+                            "zero-loss cell migration between live "
+                            "servers (doc/balancer.md); false pins the "
+                            "static placement")
+        p.add_argument("-balancer-imbalance", type=float,
+                       default=self.balancer_imbalance_enter,
+                       help="max/mean per-server load ratio above which "
+                            "a migration is planned (exit threshold "
+                            "stays at its default unless retuned in "
+                            "code)")
+        p.add_argument("-balancer-budget", type=int,
+                       default=self.balancer_budget_per_epoch,
+                       help="committed migrations allowed per epoch "
+                            "(epoch = balancer_epoch_ticks GLOBAL "
+                            "ticks)")
+        p.add_argument("-balancer-cooldown", type=int,
+                       default=self.balancer_cooldown_ticks,
+                       help="GLOBAL ticks a migrated cell is locked out "
+                            "of re-migration (anti-oscillation)")
         p.add_argument("-mesh-devices", type=int, default=self.tpu_mesh_devices,
                        help="shard the spatial engine over N devices "
                             "(0 = single-device step)")
@@ -332,6 +394,16 @@ class GlobalSettings:
         self.overload_down_hold_s = args.overload_down_hold
         self.failover_enabled = args.failover
         self.failover_rehost_deadline_s = args.failover_deadline
+        self.balancer_enabled = args.balancer
+        self.balancer_imbalance_enter = args.balancer_imbalance
+        # The flag only moves the ENTER threshold; keep the exit strictly
+        # below it or the two-sided hysteresis band inverts (armed one
+        # tick, disarmed the next, forever).
+        self.balancer_imbalance_exit = min(
+            self.balancer_imbalance_exit, args.balancer_imbalance * 0.8
+        )
+        self.balancer_budget_per_epoch = args.balancer_budget
+        self.balancer_cooldown_ticks = args.balancer_cooldown
         self.spatial_backend = args.spatial_backend
         self.tpu_mesh_devices = args.mesh_devices
         self.tpu_mesh_hosts = args.mesh_hosts

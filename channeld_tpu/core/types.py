@@ -69,6 +69,7 @@ class MessageType(IntEnum):
     CHANNEL_OWNER_RECOVERED = 23
     SERVER_BUSY = 24
     CELL_REHOSTED = 25
+    CELL_MIGRATED = 26
     DEBUG_GET_SPATIAL_REGIONS = 99
     USER_SPACE_START = 100
 

@@ -40,6 +40,7 @@ MESSAGE_TEMPLATES = {
     23: control_pb2.ChannelOwnerRecoveredMessage,
     24: control_pb2.ServerBusyMessage,
     25: spatial_pb2.CellRehostedMessage,
+    26: spatial_pb2.CellMigratedMessage,
     99: spatial_pb2.DebugGetSpatialRegionsMessage,
 }
 

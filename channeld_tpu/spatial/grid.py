@@ -21,6 +21,7 @@ from typing import Callable, Optional
 
 from ..core.overload import governor as _governor
 from ..core.settings import global_settings
+from .balancer import balancer as _balancer
 from ..core.types import ChannelType, ConnectionType, MessageType
 from ..protocol import control_pb2, spatial_pb2
 from ..utils.anyutil import pack_any
@@ -377,12 +378,16 @@ class StaticGrid2DSpatialController:
                     sub_cell(sx * sgc + x, (sy + 1) * sgr + y)
 
     def tick(self) -> None:
-        """Reap closed server connections (ref: spatial.go:884-893)."""
+        """Reap closed server connections (ref: spatial.go:884-893), then
+        run the load-balancer update (doc/balancer.md) — both inside the
+        GLOBAL channel tick, the single-writer context every channel
+        mutation here requires."""
         self._init_server_connections()
         for i, conn in enumerate(self.server_connections):
             if conn is not None and conn.is_closing():
                 self.server_connections[i] = None
                 logger.info("reset spatial server connection %d", i)
+        _balancer.update(self)
 
     # ---- handover --------------------------------------------------------
 
@@ -406,6 +411,24 @@ class StaticGrid2DSpatialController:
             return
         if src_channel_id == dst_channel_id:
             return
+        frozen = _balancer.frozen_cells
+        if frozen or _balancer._frozen_crossings:
+            # A live migration has a cell frozen: park crossings that
+            # touch it (one pending move per entity; chains collapse) —
+            # they replay through the batched orchestration on
+            # unfreeze. An entity with an ALREADY-parked crossing keeps
+            # chaining into it even off-freeze: its true origin is the
+            # parked entry's.
+            eid = handover_data_provider(-1, -1)
+            if eid is not None and (
+                src_channel_id in frozen
+                or dst_channel_id in frozen
+                or eid in _balancer._frozen_crossings
+            ):
+                _balancer.defer_crossing(
+                    eid, old_info, new_info, handover_data_provider
+                )
+                return
         self._orchestrate_pair(src_channel_id, dst_channel_id,
                                [handover_data_provider])
 
@@ -421,6 +444,7 @@ class StaticGrid2DSpatialController:
         measured 87.8us each (11.4K/s, scripts/bench_handover.py) — far
         under the 44.5K/s detection rate, hence this path."""
         groups: dict = {}  # insertion-ordered: first-crossing pair order
+        frozen = _balancer.frozen_cells
         for old_info, new_info, provider in crossings:
             try:
                 s = self.get_channel_id(old_info)
@@ -430,6 +454,26 @@ class StaticGrid2DSpatialController:
                 continue
             if s == d:
                 continue
+            if frozen or _balancer._frozen_crossings:
+                eid = provider(-1, -1)
+                if eid is not None and (
+                    s in frozen
+                    or d in frozen
+                    # An entity that ALREADY has a parked crossing must
+                    # keep chaining into it even when this hop touches
+                    # no frozen cell: its true origin is the parked
+                    # entry's — orchestrating this hop now would move
+                    # data from the wrong cell and the later replay
+                    # would duplicate it.
+                    or eid in _balancer._frozen_crossings
+                ):
+                    # Live migration in flight: park the crossing with
+                    # the balancer (chains collapse per entity); it
+                    # replays through this very path once the migration
+                    # commits or aborts.
+                    _balancer.defer_crossing(eid, old_info, new_info,
+                                             provider)
+                    continue
             groups.setdefault((s, d), []).append(provider)
         for (s, d), providers in groups.items():
             self._orchestrate_pair(s, d, providers)
@@ -479,6 +523,16 @@ class StaticGrid2DSpatialController:
         if not handover_entities:
             return
         metrics.handover_count.inc(contributing)
+        # Per-cell crossing observability + the balancer's crossing-rate
+        # signal (doc/balancer.md): one orchestration counts against
+        # both ends of the pair.
+        metrics.spatial_cell_crossings.labels(
+            cell=str(src_channel_id), direction="out"
+        ).inc(contributing)
+        metrics.spatial_cell_crossings.labels(
+            cell=str(dst_channel_id), direction="in"
+        ).inc(contributing)
+        _balancer.note_crossing(src_channel_id, dst_channel_id, contributing)
 
         # Step 1: cross-server — swap entity-channel ownership first so the
         # src server's residual updates are ignored (prevents handover loops).

@@ -26,6 +26,7 @@ from typing import Callable, Optional
 from ..chaos.injector import chaos as _chaos
 from ..core.failover import journal as _journal
 from ..core.overload import governor as _governor
+from .balancer import balancer as _balancer
 from ..core.settings import global_settings
 from ..utils.logger import get_logger
 from .controller import SpatialInfo, register_spatial_controller_type
@@ -276,8 +277,10 @@ class TPUSpatialController(StaticGrid2DSpatialController):
         self._providers.pop(entity_id, None)
         self._deferred_crossings.pop(entity_id, None)
         self._data_cell.pop(entity_id, None)
-        # A destroyed entity's in-flight handover is moot.
+        # A destroyed entity's in-flight handover is moot — and so is a
+        # crossing parked behind a migration freeze (doc/balancer.md).
         _journal.forget_entity(entity_id)
+        _balancer._frozen_crossings.pop(entity_id, None)
 
     def on_cell_rehosted(self, cell_channel_id: int, new_owner) -> None:
         """Failover hook (core/failover.py): the cell's authority moved

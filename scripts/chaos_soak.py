@@ -490,6 +490,9 @@ async def run_soak(p: SoakParams) -> dict:
     reset_overload()
 
     global_settings.development = True
+    # This soak proves the CHAOS plane: the balancer's planned migrations
+    # would add nondeterministic authority moves to a seeded scenario.
+    global_settings.balancer_enabled = False
     global_settings.tpu_entity_capacity = p.entity_capacity
     global_settings.tpu_query_capacity = p.query_capacity
     # Tick cadences tuned for a live soak on a shared CPU box: GLOBAL

@@ -201,6 +201,10 @@ async def run_failover_soak(p: FailoverSoakParams) -> dict:
     # L3 admission control and refuse the soak's own client fleet (the
     # overload soak owns that interplay).
     global_settings.overload_enabled = False
+    # ... and the balancer stays off for the same reason: this soak's
+    # re-host accounting must see only CRASH-path authority moves
+    # (scripts/balance_soak.py proves the planned-migration path).
+    global_settings.balancer_enabled = False
     global_settings.server_conn_recoverable = True
     global_settings.server_conn_recover_timeout_ms = int(
         p.recover_window_s * 1000

@@ -196,6 +196,10 @@ async def run_overload_soak(p: OverloadSoakParams) -> dict:
     reset_overload()
 
     global_settings.development = True
+    # This soak proves the OVERLOAD ladder; the balancer never migrates
+    # at L2+ anyway, but pinning it off keeps the saturation timeline
+    # free of planned authority moves (scripts/balance_soak.py owns that).
+    global_settings.balancer_enabled = False
     global_settings.tpu_entity_capacity = p.entity_capacity
     global_settings.tpu_query_capacity = p.query_capacity
     global_settings.overload_down_hold_s = p.down_hold_s

@@ -54,8 +54,10 @@ if not any(
 def _fresh_globals():
     """Reset process-wide singletons between tests."""
     from channeld_tpu.core import events, overload, settings
+    from channeld_tpu.spatial import balancer as balancer_mod
 
     yield
     events.reset_all()
     settings.reset_global_settings()
     overload.reset_overload()
+    balancer_mod.reset_balancer()

@@ -1,0 +1,836 @@
+"""Live spatial load balancer (spatial/balancer.py; doc/balancer.md).
+
+Planned, zero-loss migration of live cells between live servers: the
+balancer folds per-server load into an imbalance score with hysteresis,
+a per-epoch budget and per-cell cooldown, freezes crossings for the
+migrating cell, drains the transactional handover journal, then flips
+ownership with a CellMigratedMessage bootstrap — or aborts back to the
+old owner deterministically.
+
+Also covers the satellites: the shared entity-weighted placement score
+(used by failover re-host AND the balancer), the per-cell load metrics,
+the interaction tests with the overload ladder and the handover
+journal, and the orphan-adoption fix for cells_unrehostable.
+
+The <60s seeded smoke soak drives a live gateway through a real
+single-quadrant hotspot; the acceptance soak (SOAK_BALANCE_r09.json) is
+the slow-marked variant via ``python scripts/balance_soak.py``.
+"""
+
+import asyncio
+import importlib.util
+import os
+import sys
+
+import pytest
+
+from channeld_tpu.core import connection as connection_mod
+from channeld_tpu.core import events, metrics
+from channeld_tpu.core import connection_recovery as recovery
+from channeld_tpu.core.channel import (
+    get_channel,
+    get_global_channel,
+)
+from channeld_tpu.core.connection import add_connection
+from channeld_tpu.core.failover import (
+    journal,
+    placement_score,
+    plane,
+)
+from channeld_tpu.core.fsm import MessageFsm
+from channeld_tpu.core.message import MessageContext
+from channeld_tpu.core.overload import OverloadLevel, governor
+from channeld_tpu.core.settings import global_settings
+from channeld_tpu.core.subscription import subscribe_to_channel
+from channeld_tpu.core.types import ChannelType, ConnectionType, MessageType
+from channeld_tpu.models import sim_pb2, testdata_pb2
+from channeld_tpu.models.sim import register_sim_types
+from channeld_tpu.protocol import (
+    FrameDecoder,
+    MESSAGE_TEMPLATES,
+    control_pb2,
+    encode_packet,
+    spatial_pb2,
+    wire_pb2,
+)
+from channeld_tpu.spatial.balancer import balancer
+from channeld_tpu.spatial.controller import (
+    SpatialInfo,
+    set_spatial_controller,
+)
+from channeld_tpu.spatial.grid import StaticGrid2DSpatialController
+
+from helpers import FakeTransport, fresh_runtime
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+AUTH_FSM = {
+    "States": [
+        {"Name": "INIT", "MsgTypeWhitelist": "1", "MsgTypeBlacklist": ""},
+        {"Name": "OPEN", "MsgTypeWhitelist": "2-65535", "MsgTypeBlacklist": ""},
+    ],
+    "Transitions": [],
+}
+
+
+@pytest.fixture(autouse=True)
+def runtime():
+    gch = fresh_runtime()
+    register_sim_types()
+    global_settings.development = True
+    global_settings.server_conn_recoverable = True
+    connection_mod.set_fsm_templates(
+        MessageFsm.from_dict(AUTH_FSM), MessageFsm.from_dict(AUTH_FSM)
+    )
+    yield gch
+
+
+def wire(msg_type, msg, ch=0):
+    return encode_packet(wire_pb2.Packet(messages=[wire_pb2.MessagePack(
+        channelId=ch, msgType=msg_type, msgBody=msg.SerializeToString()
+    )]))
+
+
+def sent_messages(t):
+    dec = FrameDecoder()
+    out = []
+    for chunk in t.written:
+        for p in dec.decode_packets(chunk):
+            out.extend(p.messages)
+    return out
+
+
+def auth_server(pit):
+    t = FakeTransport()
+    conn = add_connection(t, ConnectionType.SERVER)
+    conn.on_bytes(wire(MessageType.AUTH, control_pb2.AuthMessage(
+        playerIdentifierToken=pit)))
+    get_global_channel().tick_once(0)
+    return conn, t
+
+
+def auth_client(pit):
+    t = FakeTransport()
+    conn = add_connection(t, ConnectionType.CLIENT)
+    conn.on_bytes(wire(MessageType.AUTH, control_pb2.AuthMessage(
+        playerIdentifierToken=pit)))
+    get_global_channel().tick_once(0)
+    return conn, t
+
+
+def make_grid(cols=4, servers=None, border=0):
+    """A 1-row host-grid world; each server claims cols/len(servers)
+    cells, with sim-typed channel data (has an entity table)."""
+    ctl = StaticGrid2DSpatialController()
+    ctl.load_config(dict(
+        WorldOffsetX=0, WorldOffsetZ=0, GridWidth=100, GridHeight=100,
+        GridCols=cols, GridRows=1, ServerCols=len(servers), ServerRows=1,
+        ServerInterestBorderSize=border,
+    ))
+    set_spatial_controller(ctl)
+    cells = []
+    for server in servers:
+        chs = ctl.create_channels(MessageContext(
+            msg_type=MessageType.CREATE_CHANNEL,
+            msg=control_pb2.CreateChannelMessage(),
+            connection=server,
+        ))
+        for ch in chs:
+            ch.init_data(sim_pb2.SimSpatialChannelData(), None)
+            subscribe_to_channel(server, ch, None)
+        cells.extend(chs)
+    return ctl, cells
+
+
+def fill_entities(cell, n, base=0x80100):
+    for i in range(n):
+        eid = base + i
+        d = sim_pb2.SimEntityChannelData()
+        d.state.entityId = eid
+        cell.get_data_message().add_entity(eid, d)
+
+
+def tune_balancer(**over):
+    """Small-world-friendly knobs for the unit tests."""
+    global_settings.balancer_min_entity_delta = over.pop("min_delta", 4)
+    global_settings.balancer_hold_ticks = over.pop("hold", 2)
+    global_settings.balancer_freeze_min_ticks = over.pop("freeze_min", 1)
+    for k, v in over.items():
+        setattr(global_settings, f"balancer_{k}", v)
+
+
+# ---- the shared placement score (satellite) --------------------------------
+
+
+def test_placement_score_deprioritizes_entity_heavy_servers():
+    """A server with few cells but huge entity load must rank WORSE than
+    one with more cells and no entities (the old fewest-owned-cells rule
+    got this backwards)."""
+    assert placement_score(1, 200) > placement_score(3, 0)
+    assert placement_score(2, 0) < placement_score(1, 32)
+    # Equal entities: fewest cells still wins.
+    assert placement_score(1, 8) < placement_score(2, 8)
+
+
+def test_failover_rehost_picks_low_entity_server():
+    """Regression for the 'few cells but huge' pick: the orphan goes to
+    the server with MORE cells but no entities."""
+    server_b, _ = auth_server("pl-b")
+    server_c, _ = auth_server("pl-c")
+    from channeld_tpu.core.channel import create_channel
+
+    # b: one cell, crammed. c: two empty cells.
+    heavy = create_channel(ChannelType.SPATIAL, server_b)
+    heavy.init_data(sim_pb2.SimSpatialChannelData(), None)
+    fill_entities(heavy, 200)
+    for _ in range(2):
+        ch = create_channel(ChannelType.SPATIAL, server_c)
+        ch.init_data(sim_pb2.SimSpatialChannelData(), None)
+    orphan = create_channel(ChannelType.SPATIAL, None)
+    orphan.init_data(sim_pb2.SimSpatialChannelData(), None)
+
+    plane._run(events.ServerLostData(
+        pit="pl-dead", prev_conn_id=999,
+        owned_channel_ids=[orphan.id], subscribed_channel_ids=[],
+    ))
+    assert orphan.get_owner() is server_c
+
+
+# ---- the migration transaction ---------------------------------------------
+
+
+def test_hot_cell_migrates_with_bootstrap_and_resync():
+    """Tentpole core: sustained imbalance -> the hottest cell on the
+    loaded server freezes, drains, and flips to the idle server — WRITE
+    sub + CellMigratedMessage bootstrap carrying packed authoritative
+    state; the old owner downgrades to READ and gets the identifier-only
+    copy; a watching client gets a full-state resync."""
+    gch = get_global_channel()
+    sa, ta = auth_server("mig-a")
+    sb, tb = auth_server("mig-b")
+    ctl, cells = make_grid(4, [sa, sb])
+    hot, warm = cells[0], cells[1]
+    fill_entities(hot, 12)
+    fill_entities(warm, 8, base=0x80300)
+
+    watcher, tw = auth_client("mig-w")
+    subscribe_to_channel(watcher, hot, None)
+    wcs = hot.subscribed_connections[watcher]
+    wcs.fanout_conn.had_first_fanout = True  # past its first full state
+
+    tune_balancer()
+    before = dict(balancer.ledger)
+    for _ in range(10):
+        gch.tick_once(0)
+        if hot.get_owner() is sb:
+            break
+    assert hot.get_owner() is sb
+    assert balancer.ledger.get("committed", 0) == 1
+    assert balancer.ledger.get("planned", 0) == 1
+    assert hot.subscribed_connections[sb].options.dataAccess == 2  # WRITE
+    assert hot.subscribed_connections[sa].options.dataAccess == 1  # READ now
+    # Metric mirrors the ledger exactly.
+    assert metrics.balancer_migrations.labels(
+        result="committed")._value.get() >= 1
+
+    ta.written.clear()
+    tb.written.clear()
+    tw.written.clear()
+    hot.tick_once(hot.get_time())  # the announce ran in-queue
+    sa.flush()
+    sb.flush()
+    watcher.flush()
+
+    boot = [m for m in sent_messages(tb)
+            if m.msgType == MessageType.CELL_MIGRATED]
+    assert len(boot) == 1
+    bmsg = spatial_pb2.CellMigratedMessage()
+    bmsg.ParseFromString(boot[0].msgBody)
+    assert bmsg.channelId == hot.id
+    assert bmsg.prevOwnerConnId == sa.id
+    assert bmsg.newOwnerConnId == sb.id
+    assert bmsg.HasField("channelData")  # the snapshot-pack bootstrap
+    data = sim_pb2.SimSpatialChannelData()
+    bmsg.channelData.Unpack(data)
+    assert len(data.entities) == 12
+
+    for t in (ta, tw):
+        note = [m for m in sent_messages(t)
+                if m.msgType == MessageType.CELL_MIGRATED]
+        assert len(note) == 1
+        nmsg = spatial_pb2.CellMigratedMessage()
+        nmsg.ParseFromString(note[0].msgBody)
+        assert not nmsg.HasField("channelData")  # identifier-only copy
+    # The watcher's delta stream is void across an authority change.
+    assert wcs.fanout_conn.had_first_fanout is False
+
+    # No oscillation: the migrated cell is in cooldown, the world is
+    # balanced; nothing else moves.
+    for _ in range(30):
+        gch.tick_once(0)
+    assert balancer.ledger.get("committed", 0) == 1
+
+
+def test_migration_respects_budget_and_cooldown():
+    """Two hot cells, budget of one commit per epoch: exactly one
+    migration this epoch; and the migrated cell never re-migrates within
+    its cooldown even though the imbalance persists."""
+    gch = get_global_channel()
+    sa, _ = auth_server("bud-a")
+    sb, _ = auth_server("bud-b")
+    ctl, cells = make_grid(4, [sa, sb])
+    fill_entities(cells[0], 20)
+    fill_entities(cells[1], 16, base=0x80400)
+
+    tune_balancer(budget_per_epoch=1, epoch_ticks=1000, cooldown_ticks=1000)
+    for _ in range(20):
+        gch.tick_once(0)
+    assert balancer.ledger.get("committed", 0) == 1  # budget spent
+    owners = {cells[0].get_owner(), cells[1].get_owner()}
+    assert sb in owners  # one of the two hot cells moved
+
+
+def test_migration_vetoed_at_overload_l2():
+    """Interaction with the overload ladder: migrations are extra load,
+    so L2+ vetoes planning outright (count in {result=vetoed})."""
+    gch = get_global_channel()
+    sa, _ = auth_server("ov-a")
+    sb, _ = auth_server("ov-b")
+    ctl, cells = make_grid(4, [sa, sb])
+    fill_entities(cells[0], 12)
+    fill_entities(cells[1], 8, base=0x80300)
+
+    tune_balancer()
+    governor.level = OverloadLevel.L2
+    try:
+        for _ in range(10):
+            gch.tick_once(0)
+        assert balancer.ledger.get("committed", 0) == 0
+        assert balancer.ledger.get("vetoed", 0) >= 1
+        assert cells[0].get_owner() is sa
+        assert balancer.frozen_cells == frozenset()
+    finally:
+        governor.level = OverloadLevel.L0
+
+
+def test_migration_vetoed_when_destination_pressured():
+    """A destination sitting at L2-grade pressure never receives a
+    migration even while the gateway-wide ladder is at L0."""
+    gch = get_global_channel()
+    sa, _ = auth_server("dp-a")
+    sb, _ = auth_server("dp-b")
+    ctl, cells = make_grid(4, [sa, sb])
+    fill_entities(cells[0], 12)
+    fill_entities(cells[1], 8, base=0x80300)
+
+    # Pressure weight 1 so the pinned pressure flags the destination as
+    # ineligible without also making it the "hottest" server outright.
+    tune_balancer(pressure_weight=1.0)
+    try:
+        for _ in range(10):
+            # Pin the destination hot (the EWMA would otherwise decay it
+            # between updates — in a live gateway the server's own tick
+            # cost keeps feeding it).
+            governor.server_pressure[sb.id] = 1.5
+            gch.tick_once(0)
+        assert balancer.ledger.get("committed", 0) == 0
+        assert balancer.ledger.get("vetoed", 0) >= 1
+        assert cells[0].get_owner() is sa
+    finally:
+        governor.server_pressure.clear()
+
+
+def test_migration_waits_for_in_flight_handover_journal():
+    """Race with a concurrent entity handover out of the migrating cell:
+    the journal serializes them — the owner flip only happens once no
+    in-flight record touches the cell."""
+    gch = get_global_channel()
+    sa, _ = auth_server("jr-a")
+    sb, _ = auth_server("jr-b")
+    ctl, cells = make_grid(4, [sa, sb])
+    hot = cells[0]
+    fill_entities(hot, 12)
+    fill_entities(cells[1], 8, base=0x80300)
+
+    # A handover of one entity out of the hot cell is mid-flight
+    # (prepared, neither hop executed).
+    records = journal.prepare({0x80100: None}, hot.id, cells[1].id)
+
+    tune_balancer()
+    for _ in range(10):
+        gch.tick_once(0)
+    mig = balancer.migration_in_flight()
+    assert mig is not None and mig.cell_id == hot.id  # planned + frozen
+    assert hot.get_owner() is sa  # ...but NOT executed: journal busy
+    assert balancer.frozen_cells == frozenset((hot.id,))
+
+    journal.commit(records)  # the dst tick ran; the record resolves
+    for _ in range(5):
+        gch.tick_once(0)
+        if hot.get_owner() is sb:
+            break
+    assert hot.get_owner() is sb
+    assert balancer.migration_in_flight() is None
+    assert balancer.frozen_cells == frozenset()
+    assert balancer.ledger.get("committed", 0) == 1
+
+
+def test_migration_drain_timeout_aborts():
+    """A journal record that never resolves cannot wedge the balancer:
+    past the drain deadline the migration aborts back to the old
+    owner."""
+    gch = get_global_channel()
+    sa, _ = auth_server("dt-a")
+    sb, _ = auth_server("dt-b")
+    ctl, cells = make_grid(4, [sa, sb])
+    hot = cells[0]
+    fill_entities(hot, 12)
+    fill_entities(cells[1], 8, base=0x80300)
+    journal.prepare({0x80100: None}, hot.id, cells[1].id)  # never resolves
+
+    tune_balancer(drain_deadline_ticks=5)
+    for _ in range(20):
+        gch.tick_once(0)
+        if balancer.ledger.get("aborted", 0):
+            break
+    assert balancer.ledger.get("aborted", 0) == 1
+    assert balancer.ledger.get("committed", 0) == 0
+    assert hot.get_owner() is sa
+    assert balancer.frozen_cells == frozenset()
+    journal.reset()  # don't leak the synthetic record into other checks
+
+
+def test_crash_mid_migration_aborts_to_old_owner():
+    """The destination dies inside the freeze/drain window: the
+    migration aborts deterministically — the old owner keeps the cell,
+    nothing moved, the freeze lifts."""
+    gch = get_global_channel()
+    sa, _ = auth_server("cr-a")
+    sb, _ = auth_server("cr-b")
+    ctl, cells = make_grid(4, [sa, sb])
+    hot = cells[0]
+    fill_entities(hot, 12)
+    fill_entities(cells[1], 8, base=0x80300)
+
+    tune_balancer(freeze_min=50)  # a wide window to crash into
+    for _ in range(10):
+        gch.tick_once(0)
+        if balancer.migration_in_flight() is not None:
+            break
+    mig = balancer.migration_in_flight()
+    assert mig is not None and mig.dst_conn is sb
+
+    sb.close(unexpected=True)  # the crash
+    for _ in range(5):
+        gch.tick_once(0)
+        if balancer.migration_in_flight() is None:
+            break
+    assert balancer.migration_in_flight() is None
+    assert balancer.ledger.get("aborted", 0) == 1
+    assert balancer.ledger.get("committed", 0) == 0
+    assert hot.get_owner() is sa  # rollback: old owner keeps the cell
+    assert balancer.frozen_cells == frozenset()
+    ev = balancer.events[-1]
+    assert ev["result"] == "dst_dead"
+    # Ledger == metric, per result label.
+    for result, n in balancer.ledger.items():
+        assert metrics.balancer_migrations.labels(
+            result=result)._value.get() >= n
+
+
+def test_frozen_cell_defers_crossings_and_replays_after_commit():
+    """Crossings into/out of a migrating cell are frozen (parked with
+    the balancer) and replay through the normal orchestration once the
+    migration commits — no crossing lost, no duplicate data."""
+    gch = get_global_channel()
+    sa, _ = auth_server("fz-a")
+    sb, _ = auth_server("fz-b")
+    ctl, cells = make_grid(4, [sa, sb], border=0)
+    hot = cells[0]
+    fill_entities(hot, 12)
+    fill_entities(cells[1], 8, base=0x80300)
+    # A live entity channel resident in the hot cell.
+    from channeld_tpu.core.channel import create_entity_channel
+
+    eid = 0x80100  # matches the first fill_entities id
+    ech = create_entity_channel(eid, sa)
+    d = sim_pb2.SimEntityChannelData()
+    d.state.entityId = eid
+    d.state.transform.position.x = 30
+    d.state.transform.position.z = 50
+    ech.init_data(d, None)
+    ech.spatial_notifier = ctl
+    subscribe_to_channel(sa, ech, None)
+
+    tune_balancer(freeze_min=50)
+    for _ in range(10):
+        gch.tick_once(0)
+        if balancer.migration_in_flight() is not None:
+            break
+    assert balancer.frozen_cells == frozenset((hot.id,))
+
+    # The entity crosses out of the frozen cell (host notify path).
+    ctl.notify(
+        SpatialInfo(30, 0, 50), SpatialInfo(150, 0, 50), lambda s, d: eid
+    )
+    assert eid in balancer._frozen_crossings  # parked, not orchestrated
+    assert eid in hot.get_data_message().entities  # data untouched
+
+    global_settings.balancer_freeze_min_ticks = 1  # let it execute now
+    for _ in range(5):
+        gch.tick_once(0)
+        if balancer.migration_in_flight() is None:
+            break
+    assert balancer.ledger.get("committed", 0) == 1
+    assert balancer._frozen_crossings == {}  # replayed on unfreeze
+    # The replayed handover ran: both hops queued; run the cell ticks.
+    hot.tick_once(0)
+    cells[1].tick_once(0)
+    assert eid not in hot.get_data_message().entities
+    assert eid in cells[1].get_data_message().entities
+    jc = journal.counts
+    assert jc.get("prepared", 0) == (
+        jc.get("committed", 0) + jc.get("aborted", 0)
+    ) + journal.in_flight_count()
+
+
+def test_parked_entity_chains_through_unfrozen_hops_without_duplicating():
+    """Regression: an entity with a parked frozen crossing that keeps
+    moving through UNFROZEN cells must chain into the park (true origin
+    pinned), not orchestrate the later hop independently — the stale
+    replay used to leave its data duplicated across two cells."""
+    gch = get_global_channel()
+    sa, _ = auth_server("ch-a")
+    sb, _ = auth_server("ch-b")
+    ctl, cells = make_grid(6, [sa, sb])  # three cells per server
+    hot = cells[1]  # the cell that will freeze (entity crosses INTO it)
+    fill_entities(cells[1], 12, base=0x80500)
+    fill_entities(cells[2], 8, base=0x80600)
+
+    from channeld_tpu.core.channel import create_entity_channel
+
+    eid = 0x80100
+    ech = create_entity_channel(eid, sa)
+    d = sim_pb2.SimEntityChannelData()
+    d.state.entityId = eid
+    d.state.transform.position.x = 50
+    ech.init_data(d, None)
+    ech.spatial_notifier = ctl
+    cells[0].get_data_message().add_entity(eid, d)
+
+    tune_balancer(freeze_min=50)
+    for _ in range(10):
+        gch.tick_once(0)
+        if balancer.migration_in_flight() is not None:
+            break
+    mig = balancer.migration_in_flight()
+    assert mig is not None
+    frozen_id = mig.cell_id
+    frozen_idx = frozen_id - global_settings.spatial_channel_id_start
+
+    def x_of(idx):
+        return idx * 100.0 + 50.0
+
+    # Hop 1: cell0 -> frozen cell (parked).
+    ctl.notify(SpatialInfo(x_of(0), 0, 50),
+               SpatialInfo(x_of(frozen_idx), 0, 50), lambda s, dd: eid)
+    assert eid in balancer._frozen_crossings
+    # Hop 2: frozen cell -> cell3 (parked, merged).
+    ctl.notify(SpatialInfo(x_of(frozen_idx), 0, 50),
+               SpatialInfo(x_of(3), 0, 50), lambda s, dd: eid)
+    # Hop 3: cell3 -> cell5 — touches NO frozen cell, but the entity has
+    # a parked crossing: must chain into it, not orchestrate.
+    ctl.notify(SpatialInfo(x_of(3), 0, 50),
+               SpatialInfo(x_of(5), 0, 50), lambda s, dd: eid)
+    assert len(balancer._frozen_crossings) == 1
+    assert eid in cells[0].get_data_message().entities  # data untouched
+
+    global_settings.balancer_freeze_min_ticks = 1
+    for _ in range(5):
+        gch.tick_once(0)
+        if balancer.migration_in_flight() is None:
+            break
+    assert balancer.migration_in_flight() is None
+    for ch in cells:
+        ch.tick_once(0)  # run the queued remove/add hops
+    holders = [ch.id for ch in cells
+               if eid in (ch.get_data_message().entities or {})]
+    assert holders == [cells[5].id]  # exactly once, at the FINAL position
+    assert journal.in_flight_count() == 0
+
+
+def test_balancer_disabled_never_migrates():
+    gch = get_global_channel()
+    sa, _ = auth_server("off-a")
+    sb, _ = auth_server("off-b")
+    ctl, cells = make_grid(4, [sa, sb])
+    fill_entities(cells[0], 20)
+    tune_balancer()
+    global_settings.balancer_enabled = False
+    for _ in range(15):
+        gch.tick_once(0)
+    assert balancer.ledger == {}
+    assert cells[0].get_owner() is sa
+
+
+# ---- per-cell observability (satellite) ------------------------------------
+
+
+def test_per_cell_load_metrics_feed():
+    gch = get_global_channel()
+    sa, _ = auth_server("mx-a")
+    sb, _ = auth_server("mx-b")
+    ctl, cells = make_grid(4, [sa, sb])
+    fill_entities(cells[0], 9)
+    tune_balancer(min_delta=100)  # observe only; no migration
+    gch.tick_once(0)
+    assert metrics.spatial_cell_entities.labels(
+        cell=str(cells[0].id))._value.get() == 9
+    assert metrics.spatial_cell_entities.labels(
+        cell=str(cells[1].id))._value.get() == 0
+
+    before = metrics.spatial_cell_crossings.labels(
+        cell=str(cells[0].id), direction="out")._value.get()
+    from channeld_tpu.core.channel import create_entity_channel
+
+    eid = 0x80100
+    ech = create_entity_channel(eid, sa)
+    d = sim_pb2.SimEntityChannelData()
+    d.state.entityId = eid
+    ech.init_data(d, None)
+    ech.spatial_notifier = ctl
+    ctl.notify(SpatialInfo(30, 0, 50), SpatialInfo(150, 0, 50),
+               lambda s, dd: eid)
+    after_out = metrics.spatial_cell_crossings.labels(
+        cell=str(cells[0].id), direction="out")._value.get()
+    after_in = metrics.spatial_cell_crossings.labels(
+        cell=str(cells[1].id), direction="in")._value.get()
+    assert after_out == before + 1
+    assert after_in >= 1
+
+
+# ---- orphan adoption on registration (satellite fix) -----------------------
+
+
+def test_new_server_registration_adopts_unrehostable_cells():
+    """Regression: a total loss leaves cells_unrehostable orphans; a NEW
+    server registering later must adopt them via the balancer's
+    placement path (previously they stayed dark forever)."""
+    gch = get_global_channel()
+    sa, _ = auth_server("ad-a")
+    sb, _ = auth_server("ad-b")
+    ctl, cells = make_grid(4, [sa, sb])
+    # Both servers die for good: no survivor to re-host onto. The
+    # window stays wide while the close propagates (a 1ms window left
+    # over from the previous iteration could reap the fresh handle
+    # during the pre-expiry ticks), then shrinks for the forced expiry.
+    for pit, conn in (("ad-a", sa), ("ad-b", sb)):
+        global_settings.server_conn_recover_timeout_ms = 60_000
+        conn.close(unexpected=True)
+        for ch in cells:
+            ch.tick_once(ch.get_time())
+        gch.tick_once(0)
+        handle = recovery.get_recover_handle(pit)
+        assert handle is not None
+        global_settings.server_conn_recover_timeout_ms = 1
+        handle.disconn_time -= 10
+        recovery.tick_connection_recovery_once()
+        gch.tick_once(0)
+    assert plane.ledger["cells_unrehostable"] == 4
+    assert all(not ch.has_owner() for ch in cells)
+
+    rehost_before = metrics.failover_rehost._value.get()
+    fresh, _ = auth_server("ad-new")  # registration triggers adoption
+    gch.tick_once(0)  # the adoption runs in the GLOBAL tick
+    assert all(ch.get_owner() is fresh for ch in cells)
+    assert metrics.failover_rehost._value.get() == rehost_before + 4
+    ev = plane.events[-1]
+    assert ev["reason"] == "registration_adoption"
+    assert len(ev["rehosted"]) == 4
+
+
+def test_registration_adoption_skips_recovery_window_cells():
+    """A cell whose owner is merely inside its recovery window must NOT
+    be adopted out from under it."""
+    gch = get_global_channel()
+    sa, _ = auth_server("rw-a")
+    sb, _ = auth_server("rw-b")
+    ctl, cells = make_grid(4, [sa, sb])
+    global_settings.server_conn_recover_timeout_ms = 60_000
+    sa.close(unexpected=True)
+    for ch in cells[:2]:
+        ch.tick_once(ch.get_time())  # stash the recoverable owner sub
+    assert not cells[0].has_owner()
+    assert any(rs.is_owner for rs in cells[0].recoverable_subs.values())
+
+    fresh, _ = auth_server("rw-new")
+    gch.tick_once(0)
+    assert not cells[0].has_owner()  # left for the recovering owner
+
+
+def test_imbalance_flag_keeps_exit_below_enter():
+    """-balancer-imbalance below the default exit threshold must pull
+    the exit down with it — an inverted hysteresis band would arm and
+    disarm on alternating ticks forever."""
+    import shlex
+
+    global_settings.parse_flags(shlex.split(
+        "-chs config/channel_settings_hifi.json -balancer-imbalance 1.2"
+    ))
+    assert global_settings.balancer_imbalance_enter == 1.2
+    assert global_settings.balancer_imbalance_exit < 1.2
+
+
+# ---- protocol surface ------------------------------------------------------
+
+
+def test_cell_migrated_message_round_trip_and_registry():
+    assert MESSAGE_TEMPLATES[int(MessageType.CELL_MIGRATED)] is (
+        spatial_pb2.CellMigratedMessage
+    )
+    m = spatial_pb2.CellMigratedMessage(
+        channelId=0x10002, prevOwnerConnId=3, newOwnerConnId=5,
+        entityIds=[0x80001, 0x80002], migrationId=42,
+    )
+    assert not m.HasField("channelData")
+    m2 = spatial_pb2.CellMigratedMessage.FromString(m.SerializeToString())
+    assert (m2.channelId, m2.prevOwnerConnId, m2.newOwnerConnId,
+            m2.migrationId) == (0x10002, 3, 5, 42)
+    assert list(m2.entityIds) == [0x80001, 0x80002]
+
+
+# ---- the seeded smoke soak (tier-1) ---------------------------------------
+
+
+def _load_balance_soak():
+    spec = importlib.util.spec_from_file_location(
+        "balance_soak", os.path.join(REPO, "scripts", "balance_soak.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["balance_soak"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_balance_smoke_soak():
+    """Seeded <60s live soak: a real gateway under a single-quadrant
+    hotspot commits at least one planned migration, flattens the
+    per-server entity load, loses no entity, and stays within budget."""
+    mod = _load_balance_soak()
+    p = mod.BalanceSoakParams(
+        warmup_s=3.0, hotspot_s=14.0, aftermath_s=4.0, quiesce_s=4.0,
+        clients=6, entities=96, msg_rate=15.0,
+        kill_mid_migration=False,
+        epoch_ticks=60, cooldown_ticks=150, freeze_min_ticks=3,
+    )
+    report = asyncio.run(mod.run_balance_soak(p))
+    failed = [c for c in report["invariants"]["checks"] if not c["ok"]]
+    assert report["invariants"]["ok"], failed
+    assert report["stats"]["migrations_committed"] >= 1
+    assert report["steady_state"]["entity_imbalance"] <= p.imbalance_enter
+
+
+@pytest.mark.slow
+def test_balance_full_soak():
+    """The acceptance soak (SOAK_BALANCE_r09.json form): hotspot + the
+    destination kill mid-migration."""
+    mod = _load_balance_soak()
+    p = mod.BalanceSoakParams()
+    report = asyncio.run(mod.run_balance_soak(p))
+    failed = [c for c in report["invariants"]["checks"] if not c["ok"]]
+    assert report["invariants"]["ok"], failed
+
+
+# ---- soak artifact schema --------------------------------------------------
+
+
+def _validate_balance_artifact(report: dict) -> list[str]:
+    """Schema check for the balance-soak artifact (SOAK_BALANCE_*.json):
+    the keys the acceptance criteria and the operator runbook
+    (doc/balancer.md) read. Returns a list of violations."""
+    errs = []
+
+    def need(d, key, typ, where):
+        if key not in d:
+            errs.append(f"{where}: missing '{key}'")
+            return None
+        if typ is not None and not isinstance(d[key], typ):
+            errs.append(f"{where}: '{key}' is {type(d[key]).__name__}, "
+                        f"want {typ}")
+            return None
+        return d[key]
+
+    if need(report, "kind", str, "root") != "balance_soak":
+        errs.append("root: kind != balance_soak")
+    need(report, "scenario", dict, "root")
+    need(report, "balancer_knobs", dict, "root")
+    bal = need(report, "balancer", dict, "root") or {}
+    need(bal, "ledger", dict, "balancer")
+    for i, e in enumerate(need(bal, "events", list, "balancer") or []):
+        need(e, "cell", int, f"events[{i}]")
+        need(e, "from", int, f"events[{i}]")
+        need(e, "to", int, f"events[{i}]")
+        need(e, "result", str, f"events[{i}]")
+        need(e, "epoch", int, f"events[{i}]")
+        need(e, "duration_ms", (int, float), f"events[{i}]")
+    ss = need(report, "steady_state", dict, "root") or {}
+    need(ss, "server_entities", dict, "steady_state")
+    need(ss, "entity_imbalance", (int, float), "steady_state")
+    kill = report.get("kill")
+    if kill is not None:
+        need(kill, "dst_pit", str, "kill")
+        need(kill, "aborted", bool, "kill")
+        need(kill, "owner_is_src_after_abort", bool, "kill")
+    jn = need(report, "journal", dict, "root") or {}
+    need(jn, "counts", dict, "journal")
+    need(jn, "in_flight", int, "journal")
+    inv = need(report, "invariants", dict, "root") or {}
+    need(inv, "ok", bool, "invariants")
+    for i, c in enumerate(need(inv, "checks", list, "invariants") or []):
+        need(c, "name", str, f"checks[{i}]")
+        need(c, "ok", bool, f"checks[{i}]")
+    stats = need(report, "stats", dict, "root") or {}
+    for key in ("migrations_committed", "migrations_aborted",
+                "steady_entity_imbalance", "global_tick_p99_s"):
+        need(stats, key, (int, float), "stats")
+    # The acceptance-bar checks must be present by name.
+    names = {c.get("name") for c in inv.get("checks", [])}
+    for required in (
+        "no_migration_while_balanced",
+        "hotspot_migrations_committed",
+        "steady_state_entity_imbalance_under_threshold",
+        "migration_metric_matches_ledger",
+        "migrations_planned_equals_committed_plus_aborted",
+        "no_migration_left_in_flight",
+        "no_frozen_crossing_left_behind",
+        "per_epoch_commits_within_budget",
+        "no_cell_migrates_twice_within_cooldown",
+        "no_lost_entity_tracking",
+        "every_entity_in_exactly_one_cell",
+        "journal_prepared_equals_committed_plus_aborted",
+        "journal_nothing_in_flight",
+        "global_tick_p99_bounded",
+    ):
+        if required not in names:
+            errs.append(f"invariants: missing check '{required}'")
+    return errs
+
+
+def test_balance_soak_artifact_schema():
+    """The committed acceptance artifact must satisfy the schema the
+    runbook and the acceptance criteria read (and stay green)."""
+    path = os.path.join(REPO, "SOAK_BALANCE_r09.json")
+    if not os.path.exists(path):
+        pytest.skip("acceptance artifact not present in this checkout")
+    import json
+
+    with open(path) as f:
+        report = json.load(f)
+    errs = _validate_balance_artifact(report)
+    assert errs == []
+    assert report["invariants"]["ok"] is True
+    assert report["stats"]["migrations_committed"] >= 1
+    # The crash-mid-migration phase ran and aborted to the old owner.
+    assert report["kill"] is not None
+    assert report["kill"]["aborted"] is True
+    assert report["kill"]["owner_is_src_after_abort"] is True
