@@ -105,9 +105,138 @@ def _preflight_backend() -> str:
     return "cpu-fallback"
 
 
+def follower_sweep() -> None:
+    """Measure ``_apply_follow_interests`` at scale (VERDICT weak #5:
+    'unmeasured at scale'): the host-side pass that re-centers every
+    auto-follow query and diffs its spatial subscriptions once per
+    GLOBAL tick. Run with ``python bench.py --follower-sweep``.
+
+    Harness: a real TPUSpatialController over the benchmark grid, all
+    225 spatial channels live, E tracked entities, F followers (stub
+    client connections) each following a distinct moving entity. One
+    engine tick produces the interest masks; the timed region is the
+    pure host pass — query re-center + interested_cells + sub diff —
+    exactly what runs inside the GLOBAL tick budget (and what the L2
+    alternate-tick deferral halves). Prints one JSON line per scale."""
+    import os
+    import sys
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from random import Random
+
+    from channeld_tpu.core import channel as channel_mod
+    from channeld_tpu.core import data as data_mod
+    from channeld_tpu.core.channel import (
+        create_channel_with_id,
+        init_channels,
+    )
+    from channeld_tpu.core.settings import global_settings
+    from channeld_tpu.core.types import ChannelType, ConnectionState, ConnectionType
+    from channeld_tpu.models.sim import register_sim_types
+    from channeld_tpu.spatial.controller import SpatialInfo
+    from channeld_tpu.spatial.tpu_controller import TPUSpatialController
+    from channeld_tpu.utils.logger import get_logger
+
+    class _Stub:
+        def __init__(self, conn_id):
+            self.id = conn_id
+            self.connection_type = ConnectionType.CLIENT
+            self.state = ConnectionState.AUTHENTICATED
+            self.spatial_subscriptions = {}
+            self.logger = get_logger(f"bench.stub.{conn_id}")
+
+        def is_closing(self):
+            return False
+
+        def send(self, ctx):
+            pass
+
+        def has_interest_in(self, ch_id):
+            return ch_id in self.spatial_subscriptions
+
+    rng = Random(42)
+    results = []
+    for followers, entities in ((64, 2_000), (256, 10_000), (1024, 20_000)):
+        channel_mod.reset_channels()
+        data_mod.reset_registries()
+        global_settings.development = True
+        global_settings.tpu_entity_capacity = 1 << 16
+        global_settings.tpu_query_capacity = 1 << 11
+        register_sim_types()
+        init_channels()
+        ctl = TPUSpatialController()
+        ctl.load_config({
+            "WorldOffsetX": -15000, "WorldOffsetZ": -15000,
+            "GridWidth": 2000, "GridHeight": 2000,
+            "GridCols": 15, "GridRows": 15,
+            "ServerCols": 3, "ServerRows": 3,
+        })
+        start = global_settings.spatial_channel_id_start
+        for i in range(15 * 15):
+            ch = create_channel_with_id(start + i, ChannelType.SPATIAL, None)
+            ch.init_data(None, None)
+        estart = global_settings.entity_channel_id_start
+        eids = []
+        for i in range(entities):
+            eid = estart + 1 + i
+            ctl.track_entity(eid, SpatialInfo(
+                rng.uniform(-14000, 14000), 0, rng.uniform(-14000, 14000)))
+            eids.append(eid)
+        for i in range(followers):
+            conn = _Stub(100_000 + i)
+            ctl.register_follow_interest(
+                conn, eids[i % len(eids)], kind=3,  # sphere
+                extent=(3000.0, 3000.0),
+            )
+        result = ctl.engine.tick()
+        ctl._apply_follow_interests(result)  # warm: first diff subscribes
+
+        iters = 20
+        total = 0.0
+        for it in range(iters):
+            # Move every followed entity so each pass pays the
+            # re-center + table write (the worst realistic case).
+            for i in range(followers):
+                eid = eids[i % len(eids)]
+                info = ctl._last_positions[eid]
+                ctl._last_positions[eid] = SpatialInfo(
+                    min(max(info.x + rng.uniform(-500, 500), -14000), 14000),
+                    0,
+                    min(max(info.z + rng.uniform(-500, 500), -14000), 14000),
+                )
+            result = ctl.engine.tick()
+            t0 = time.perf_counter()
+            ctl._apply_follow_interests(result)
+            total += time.perf_counter() - t0
+        ms_per_pass = total / iters * 1000.0
+        row = {
+            "metric": "follower_interest_pass",
+            "followers": followers,
+            "entities": entities,
+            "ms_per_pass": round(ms_per_pass, 3),
+            "us_per_follower": round(ms_per_pass * 1000.0 / followers, 2),
+            "iters": iters,
+        }
+        results.append(row)
+        print(json.dumps(row), flush=True)
+        channel_mod.reset_channels()
+        data_mod.reset_registries()
+    budget_33ms = [r for r in results if r["ms_per_pass"] > 33.0]
+    print(json.dumps({
+        "metric": "follower_interest_sweep_summary",
+        "rows": len(results),
+        "over_33ms_budget": [r["followers"] for r in budget_33ms],
+    }), flush=True)
+
+
 def main() -> None:
     import os
     import sys
+
+    if "--follower-sweep" in sys.argv:
+        follower_sweep()
+        return
 
     backend = _preflight_backend()
     if backend == "cpu-fallback":

@@ -45,6 +45,9 @@ POINTS = {
     "kcp.dup": "duplicate an outbound datagram",
     # device plane (spatial/tpu_controller.py)
     "device.dispatch_stall": "stall before the engine step (slow device dispatch)",
+    # federation trunk plane (federation/trunk.py)
+    "trunk.egress_drop": "drop an outbound trunk frame (lossy inter-gateway link)",
+    "trunk.sever": "abort the trunk socket before the write (link partition)",
 }
 
 

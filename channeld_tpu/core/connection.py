@@ -731,6 +731,10 @@ class Connection:
 
 _all_connections: dict[int, Connection] = {}
 _next_connection_id = 0
+# Connection ids promised to sessions that don't have a socket yet (a
+# staged client redirect's recovery handle, federation/plane.py); the
+# allocator must never hand one of these to a fresh connection.
+_reserved_conn_ids: set[int] = set()
 _server_fsm: Optional[MessageFsm] = None
 _client_fsm: Optional[MessageFsm] = None
 
@@ -811,7 +815,7 @@ def add_connection(transport: Transport, conn_type: ConnectionType) -> Connectio
     conn_id = None
     for _ in range(100):
         candidate = _generate_conn_id(transport, max_conn_id)
-        if candidate not in _all_connections:
+        if candidate not in _all_connections and candidate not in _reserved_conn_ids:
             conn_id = candidate
             break
     if conn_id is None:
@@ -832,6 +836,30 @@ def add_connection(transport: Transport, conn_type: ConnectionType) -> Connectio
     track_unauthenticated(conn)
     metrics.connection_num.labels(conn_type=conn.connection_type.name).inc()
     return conn
+
+
+def reserve_connection_id() -> int:
+    """Allocate (and hold) a connection id with no live socket behind it
+    — the id a staged recovery handle promises to a redirected client
+    (core/connection_recovery.py stage_recovery_handle). Released when
+    the client reclaims it through recovery, or explicitly via
+    release_connection_id when the staging is torn down."""
+
+    class _NoTransport:
+        def remote_addr(self):
+            return None
+
+    max_conn_id = (1 << global_settings.max_connection_id_bits) - 1
+    for _ in range(100):
+        candidate = _generate_conn_id(_NoTransport(), max_conn_id)
+        if candidate not in _all_connections and candidate not in _reserved_conn_ids:
+            _reserved_conn_ids.add(candidate)
+            return candidate
+    raise RuntimeError("could not reserve a free connection id")
+
+
+def release_connection_id(conn_id: int) -> None:
+    _reserved_conn_ids.discard(conn_id)
 
 
 def all_connections() -> dict[int, Connection]:
@@ -918,4 +946,5 @@ def reset_connections() -> None:
     _pending_flush.clear()
     _pending_ingest.clear()
     _stash_retry.clear()
+    _reserved_conn_ids.clear()
     _next_connection_id = 0

@@ -33,15 +33,35 @@ logger = get_logger("recovery")
 CHANNEL_DATA_RECOVERY_TIMEOUT = 1.0
 
 
+# Lifetime of a PRE-STAGED handle (client redirect, federation/plane.py)
+# when server_conn_recover_timeout_ms is 0 ("never"): a redirected
+# client that never shows up must not pin its reserved conn id and
+# per-channel stash entries forever.
+STAGED_HANDLE_TTL_MS = 30_000
+
+
 @dataclass
 class ConnectionRecoverHandle:
     prev_conn_id: int
     disconn_time: float
     new_conn: Optional["Connection"] = None
     start_recovery_time: float = 0.0
+    # True for a handle created ahead of any connection (a client
+    # redirect's pre-staged session, doc/federation.md): its conn id is
+    # reserved (not a dead socket's), and its expiry is a quiet cleanup
+    # — never a ServerLostEvent.
+    staged: bool = False
 
     def is_timed_out(self) -> bool:
+        if self.new_conn is not None and not self.new_conn.is_closing():
+            # Claimed: recovery is in progress (RECOVERY_END ends it
+            # within the recovery window). Expiring now would purge the
+            # per-channel stashes out from under the live resume — a
+            # reconnect landing just inside the window must finish.
+            return False
         timeout_ms = global_settings.server_conn_recover_timeout_ms
+        if self.staged and timeout_ms <= 0:
+            timeout_ms = STAGED_HANDLE_TTL_MS
         return timeout_ms > 0 and (time.monotonic() - self.disconn_time) > timeout_ms / 1000.0
 
 
@@ -128,10 +148,28 @@ def expire_recover_handle(
     RecoverableSubscription into every channel each server subscribed
     to. Channels configured to die with their owner still do; everything
     else is left for the failover plane (spatial cells re-host, other
-    types stay ownerless with their drops counted)."""
+    types stay ownerless with their drops counted).
+
+    A STAGED handle (pre-created for a client redirect that never
+    arrived, doc/federation.md) expires quietly instead: purge its
+    stash, release its reserved conn id, no ServerLostEvent — no
+    server died."""
     if _recover_handles.get(pit) is not handle:
         return False
     del _recover_handles[pit]
+    if handle.staged:
+        from .channel import all_channels as _staged_channels
+        from .connection import release_connection_id
+
+        for ch in list(_staged_channels().values()):
+            ch.recoverable_subs.pop(pit, None)
+        release_connection_id(handle.prev_conn_id)
+        logger.info(
+            "staged recovery handle for %s expired unclaimed (%s); "
+            "reserved conn id %d released", pit, reason,
+            handle.prev_conn_id,
+        )
+        return True
     from . import events, metrics
     from .channel import _remove_channel_after_owner_removed, all_channels
 
@@ -165,6 +203,68 @@ def expire_recover_handle(
     return True
 
 
+def stage_recovery_handle(
+    pit: str, channel_ids: list[int], sub_options=None
+) -> ConnectionRecoverHandle:
+    """Pre-create the recovery state a redirected client will claim on
+    arrival (doc/federation.md): a handle keyed by the client's PIT
+    holding a RESERVED connection id, plus a recoverable subscription on
+    each of ``channel_ids`` — so when the client connects here and auths
+    with that PIT, the ordinary recovery machinery (recover_from_handle
+    + tick_recoverable_subscriptions) restores its session: previous-id
+    reclaim, re-subscription with skipFirstFanOut, full state via
+    ChannelDataRecoveryMessage, RECOVERY_END. No fresh login, no
+    SUB_TO_CHANNEL round-trips.
+
+    Re-staging an outstanding PIT (a second redirect racing the first,
+    or a redirect while the client already holds a recovery handle here)
+    merges: the existing handle and its conn id are kept, the new
+    channels' stashes are added, and the staging clock restarts."""
+    from .channel import get_channel
+    from .connection import release_connection_id, reserve_connection_id
+
+    handle = _recover_handles.get(pit)
+    if handle is not None and handle.new_conn is None:
+        # Outstanding handle (staged earlier, or a real disconnect whose
+        # window is still open): reuse it — its prev_conn_id is the id
+        # this client should reclaim regardless of which path made it.
+        handle.disconn_time = time.monotonic()
+    else:
+        if (
+            pit not in _recover_handles
+            and len(_recover_handles) >= MAX_RECOVER_HANDLES
+        ):
+            # Same cap policy as make_recoverable, same safe degradation:
+            # with no room, the redirect proceeds unstaged (the client
+            # re-joins the destination without recovery).
+            raise RuntimeError("recovery handle table full")
+        handle = ConnectionRecoverHandle(
+            prev_conn_id=reserve_connection_id(),
+            disconn_time=time.monotonic(),
+            staged=True,
+        )
+        old = _recover_handles.get(pit)
+        if old is not None and old.staged:
+            release_connection_id(old.prev_conn_id)
+        _recover_handles[pit] = handle
+
+    opts = control_pb2.ChannelSubscriptionOptions()
+    if sub_options is not None:
+        opts.MergeFrom(sub_options)
+    now = time.monotonic()
+    for cid in channel_ids:
+        ch = get_channel(cid)
+        if ch is None or ch.is_removing():
+            continue
+        ch.recoverable_subs[pit] = RecoverableSubscription(
+            conn_handle=handle,
+            is_owner=False,
+            old_sub_time=now,
+            old_sub_options=opts,
+        )
+    return handle
+
+
 def recover_from_handle(conn: "Connection", handle: ConnectionRecoverHandle) -> None:
     """Reclaim the previous connection id (ref: connection_recovery.go:47-63)."""
     from . import connection as connection_mod
@@ -178,6 +278,8 @@ def recover_from_handle(conn: "Connection", handle: ConnectionRecoverHandle) -> 
     connection_mod._all_connections.pop(conn.id, None)
     conn.id = handle.prev_conn_id
     connection_mod._all_connections[conn.id] = conn
+    # A staged handle's id was only a reservation until this moment.
+    connection_mod.release_connection_id(handle.prev_conn_id)
     conn.recover_handle = handle
     handle.new_conn = conn
     handle.start_recovery_time = time.monotonic()

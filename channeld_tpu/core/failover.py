@@ -159,6 +159,11 @@ class HandoverRecord:
     # data (their "move" is removal-only, nothing to restore).
     data: object
     state: str = PREPARED
+    # True for cross-gateway handovers (federation/plane.py): the dst
+    # channel id names a REMOTE cell, so the local failover resolution
+    # must never judge the txn by local channel existence — the
+    # federation plane owns its commit/abort (trunk ack or timeout).
+    remote: bool = False
 
 
 class HandoverJournal:
@@ -189,13 +194,15 @@ class HandoverJournal:
     # ---- the transaction surface (called from grid orchestration) -------
 
     def prepare(
-        self, entities: dict, src_channel_id: int, dst_channel_id: int
+        self, entities: dict, src_channel_id: int, dst_channel_id: int,
+        remote: bool = False,
     ) -> list[HandoverRecord]:
         records = []
         for entity_id, data in entities.items():
             self._txn += 1
             rec = HandoverRecord(
-                self._txn, entity_id, src_channel_id, dst_channel_id, data
+                self._txn, entity_id, src_channel_id, dst_channel_id, data,
+                remote=remote,
             )
             self._in_flight[entity_id] = rec
             records.append(rec)
@@ -288,6 +295,12 @@ class HandoverJournal:
 
         aborted = []
         for entity_id, rec in list(self._in_flight.items()):
+            if rec.remote:
+                # Cross-gateway txn: the dst cell lives on another
+                # gateway, so "no local dst channel" is its NORMAL
+                # in-flight state — the federation plane resolves it
+                # (trunk ack, timeout, or trunk loss), never this pass.
+                continue
             dst = get_channel(rec.dst_channel_id)
             if dst is not None and not dst.is_removing():
                 continue  # the queued add still runs; commit will land

@@ -384,6 +384,17 @@ def handle_server_busy(ctx: MessageContext) -> None:
     )
 
 
+def handle_client_redirect(ctx: MessageContext) -> None:
+    """ClientRedirectMessage is gateway -> client only (federation plane,
+    doc/federation.md); receiving one here means a confused (or hostile)
+    peer echoed it back."""
+    logger.warning(
+        "unexpected ClientRedirectMessage from conn %s "
+        "(gateway-to-client only)",
+        getattr(ctx.connection, "id", None),
+    )
+
+
 def handle_create_channel(ctx: MessageContext) -> None:
     """(ref: message.go:318-398)."""
     from .channel import create_channel, get_global_channel
@@ -661,6 +672,7 @@ def init_message_map() -> None:
         (MessageType.CHANNEL_DATA_UPDATE, handle_channel_data_update),
         (MessageType.DISCONNECT, handle_disconnect),
         (MessageType.SERVER_BUSY, handle_server_busy),
+        (MessageType.CLIENT_REDIRECT, handle_client_redirect),
         # CREATE_SPATIAL_CHANNEL shares the CreateChannelMessage body and
         # handler (ref: message.go:52-53).
         (MessageType.CREATE_SPATIAL_CHANNEL, handle_create_channel),

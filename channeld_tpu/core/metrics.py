@@ -205,6 +205,43 @@ balancer_imbalance = Gauge(
     registry=registry,
 )
 
+# Cross-gateway federation plane (channeld_tpu/federation;
+# doc/federation.md).
+federation_handover = Counter(
+    "federation_handover",
+    "Cross-gateway handover batches by terminal result. Initiator side: "
+    "committed (remote ack, src copy torn down), aborted (trunk loss / "
+    "timeout / remote refusal — entities restored to the src cell), "
+    "refused (the abort was a remote L3 ServerBusy refusal; also counted "
+    "in aborted's restore path ledger). Receiver side: applied (entities "
+    "adopted into the local shard), refused_remote (local L3 refused the "
+    "prepare), reconciled (an applied batch purged after the initiator's "
+    "abort notice — source-wins). The python ledger in "
+    "federation/plane.py must match exactly",
+    ["result"],
+    registry=registry,
+)
+trunk_msgs = Counter(
+    "trunk_msgs",
+    "Messages crossing gateway<->gateway trunk links (direction=out "
+    "counts post-chaos egress, i.e. frames actually written)",
+    ["direction"],
+    registry=registry,
+)
+redirects = Counter(
+    "redirects",
+    "ClientRedirectMessages issued (one per client steered to the "
+    "gateway now hosting its interest anchor; staged recovery handle "
+    "confirmed by the destination before each send)",
+    registry=registry,
+)
+trunk_rtt_ms = Histogram(
+    "trunk_rtt_ms",
+    "Trunk heartbeat round-trip time, milliseconds",
+    buckets=(0.5, 1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0, 500.0),
+    registry=registry,
+)
+
 # Overload-control plane (core/overload.py; doc/overload.md).
 overload_level = Gauge(
     "overload_level",

@@ -311,6 +311,21 @@ class OverloadGovernor:
             )
         return AdmissionDecision(True)
 
+    def admit_federation_handover(self) -> AdmissionDecision:
+        """L3: refuse an inbound cross-gateway handover batch — the same
+        ServerBusyMessage semantics a refused client gets ride back over
+        the trunk, and the initiating gateway aborts the batch back to
+        its own src cell (doc/federation.md). Refused at L3 only: at L2
+        the gateway is shedding *optional* work, but an inbound handover
+        is authoritative state whose deferral the initiator would have
+        to journal anyway — refusing earlier just moves the retry churn
+        to the busier moment."""
+        if self.level >= OverloadLevel.L3:
+            return AdmissionDecision(
+                False, global_settings.overload_retry_after_ms, "federation"
+            )
+        return AdmissionDecision(True)
+
     # ---- shed accounting -------------------------------------------------
 
     def count_shed(self, reason: str, n: int = 1) -> None:

@@ -517,6 +517,22 @@ async def run_server(argv: Optional[list[str]] = None) -> None:
 
     init_spatial_controller()
 
+    fed_plane = None
+    if global_settings.federation_config:
+        from ..federation import init_federation, plane as fed_plane
+        from ..spatial.controller import get_spatial_controller
+
+        init_federation(
+            global_settings.federation_config,
+            global_settings.federation_gateway_id,
+            get_spatial_controller(),
+        )
+        logger.info(
+            "federation armed: gateway %r in %s (doc/federation.md)",
+            global_settings.federation_gateway_id,
+            global_settings.federation_config,
+        )
+
     from .metrics import serve_metrics
 
     if global_settings.metrics_port:
@@ -530,6 +546,12 @@ async def run_server(argv: Optional[list[str]] = None) -> None:
         asyncio.ensure_future(flush_loop()),
         asyncio.ensure_future(unauth_reaper_loop()),
     ]
+    if fed_plane is not None:
+        # Trunk listener + per-peer dial loops + the handover timeout
+        # reaper; staged-handle expiry needs the recovery reaper too.
+        await fed_plane.start()
+        if not global_settings.server_conn_recoverable:
+            tasks.append(asyncio.ensure_future(connection_recovery_loop()))
     if global_settings.server_conn_recoverable:
         tasks.append(asyncio.ensure_future(connection_recovery_loop()))
 

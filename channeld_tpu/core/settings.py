@@ -208,6 +208,29 @@ class GlobalSettings:
     # ladder at L2+ vetoes ALL migrations regardless).
     balancer_dest_pressure_max: float = 1.15
 
+    # Cross-gateway federation plane (new — doc/federation.md). Empty
+    # config path = the plane stays disarmed and every hook is a cheap
+    # no-op (the gateway is a self-contained world, the pre-federation
+    # behavior). With a config, G gateways jointly host one spatial
+    # world: each owns the server blocks the directory assigns it,
+    # trunk links carry cross-gateway handovers (the PR 3 transactional
+    # journal extended over the wire), and clients whose interest
+    # anchor crosses a shard boundary are redirected with a pre-staged
+    # recovery handle.
+    federation_config: str = ""
+    federation_gateway_id: str = ""
+    federation_heartbeat_ms: int = 500
+    # Heartbeats missed (as a time window) before the trunk is declared
+    # down and in-flight handovers toward that peer abort back to src.
+    federation_trunk_timeout_ms: int = 2500
+    # One cross-gateway handover batch's prepare->ack deadline; a batch
+    # past it aborts (restore to src) even on a live trunk.
+    federation_handover_timeout_ms: int = 3000
+    # Reconnect backoff: base * 2^attempt, capped, +-20% jitter
+    # (federation/trunk.py backoff_schedule — unit-tested).
+    federation_reconnect_base_ms: int = 100
+    federation_reconnect_max_ms: int = 5000
+
     # Device mesh for the spatial engine: 0 devices = single-device step;
     # N>0 shards the entity arrays over the first N jax devices, and
     # hosts>1 arranges them as a (hosts, chips) DCN x ICI mesh — the TPU
@@ -352,6 +375,12 @@ class GlobalSettings:
                        default=self.balancer_cooldown_ticks,
                        help="GLOBAL ticks a migrated cell is locked out "
                             "of re-migration (anti-oscillation)")
+        p.add_argument("-fed", type=str, default="",
+                       help="federation config JSON path (shard directory "
+                            "+ trunk addresses, doc/federation.md); empty "
+                            "disables the federation plane")
+        p.add_argument("-fed-id", type=str, default="",
+                       help="this gateway's id in the federation config")
         p.add_argument("-mesh-devices", type=int, default=self.tpu_mesh_devices,
                        help="shard the spatial engine over N devices "
                             "(0 = single-device step)")
@@ -404,6 +433,8 @@ class GlobalSettings:
         )
         self.balancer_budget_per_epoch = args.balancer_budget
         self.balancer_cooldown_ticks = args.balancer_cooldown
+        self.federation_config = args.fed
+        self.federation_gateway_id = args.fed_id
         self.spatial_backend = args.spatial_backend
         self.tpu_mesh_devices = args.mesh_devices
         self.tpu_mesh_hosts = args.mesh_hosts

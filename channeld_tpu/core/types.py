@@ -70,6 +70,17 @@ class MessageType(IntEnum):
     SERVER_BUSY = 24
     CELL_REHOSTED = 25
     CELL_MIGRATED = 26
+    CLIENT_REDIRECT = 27
+    # Federation trunk plane (gateway<->gateway links only, 30-37;
+    # doc/federation.md).
+    TRUNK_HELLO = 30
+    TRUNK_HEARTBEAT = 31
+    TRUNK_HANDOVER_PREPARE = 32
+    TRUNK_HANDOVER_ACK = 33
+    TRUNK_ABORT_NOTICE = 34
+    TRUNK_STAGE_REDIRECT = 35
+    TRUNK_STAGE_ACK = 36
+    TRUNK_DIRECTORY_UPDATE = 37
     DEBUG_GET_SPATIAL_REGIONS = 99
     USER_SPACE_START = 100
 

@@ -1,0 +1,37 @@
+"""Cross-gateway federation plane (doc/federation.md).
+
+The reference's distributed story is "N independent nodes" — gateways
+scale only by splitting disjoint client populations, so the seamless
+open world ends at one gateway's grid (scripts/federation_bench.py
+documents the gap). This package shards the *world itself* across
+gateway processes, CheetahGIS-style distributed spatial partitioning
+with Spider-style transactional cross-node migration (PAPERS.md):
+
+- :mod:`directory` — the shard directory: which gateway hosts which
+  spatial cells, loaded from config and updatable at runtime.
+- :mod:`trunk` — authenticated gateway<->gateway trunk links reusing
+  the wire framing, with heartbeats, reconnect backoff and chaos hooks
+  on egress.
+- :mod:`plane` — the federation plane: cross-gateway handover (the
+  PR 3 transactional journal extended over the trunk, deterministic
+  abort back to the source gateway on trunk loss or remote refusal)
+  and client redirect with pre-staged recovery handles.
+
+Everything is disarmed (cheap no-ops at every hook site) until
+``init_federation`` runs with a config.
+"""
+
+from .directory import ShardDirectory, directory
+from .plane import FederationPlane, init_federation, plane, reset_federation
+from .trunk import TrunkLink, backoff_schedule
+
+__all__ = [
+    "FederationPlane",
+    "ShardDirectory",
+    "TrunkLink",
+    "backoff_schedule",
+    "directory",
+    "init_federation",
+    "plane",
+    "reset_federation",
+]

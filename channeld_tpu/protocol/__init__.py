@@ -41,6 +41,7 @@ MESSAGE_TEMPLATES = {
     24: control_pb2.ServerBusyMessage,
     25: spatial_pb2.CellRehostedMessage,
     26: spatial_pb2.CellMigratedMessage,
+    27: control_pb2.ClientRedirectMessage,
     99: spatial_pb2.DebugGetSpatialRegionsMessage,
 }
 

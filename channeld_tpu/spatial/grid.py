@@ -21,6 +21,7 @@ from typing import Callable, Optional
 
 from ..core.overload import governor as _governor
 from ..core.settings import global_settings
+from ..federation.directory import directory as _shard_directory
 from .balancer import balancer as _balancer
 from ..core.types import ChannelType, ConnectionType, MessageType
 from ..protocol import control_pb2, spatial_pb2
@@ -240,6 +241,19 @@ class StaticGrid2DSpatialController:
                 )
         return regions
 
+    def server_index_of_cell(self, spatial_channel_id: int) -> int:
+        """The spatial-server index whose authority block contains the
+        cell — the same geometric mapping get_regions stamps into
+        ``SpatialRegion.serverIndex``. The shard directory
+        (federation/directory.py) resolves cell->gateway through this.
+        Raises ValueError outside the grid."""
+        index = spatial_channel_id - global_settings.spatial_channel_id_start
+        if index < 0 or index >= self.grid_cols * self.grid_rows:
+            raise ValueError(f"channel {spatial_channel_id} outside the grid")
+        gx, gy = index % self.grid_cols, index // self.grid_cols
+        sgc, sgr = self._server_grid_cols(), self._server_grid_rows()
+        return (gx // sgc) + (gy // sgr) * self.server_cols
+
     def get_adjacent_channels(self, spatial_channel_id: int) -> list[int]:
         """3x3 neighborhood minus self (ref: spatial.go:358-381)."""
         index = spatial_channel_id - global_settings.spatial_channel_id_start
@@ -262,8 +276,20 @@ class StaticGrid2DSpatialController:
         if not self.server_connections:
             self.server_connections = [None] * (self.server_cols * self.server_rows)
 
+    def _allowed_server_indices(self) -> list[int]:
+        """Server indices THIS gateway may allocate: all of them in a
+        self-contained world; only the shard directory's local block
+        assignment in a federated one (remote blocks' cells live on
+        other gateways and are never created here — doc/federation.md)."""
+        n = self.server_cols * self.server_rows
+        if _shard_directory.active:
+            return [i for i in _shard_directory.local_server_indices()
+                    if i < n]
+        return list(range(n))
+
     def _next_server_index(self) -> int:
-        for i, conn in enumerate(self.server_connections):
+        for i in self._allowed_server_indices():
+            conn = self.server_connections[i]
             if conn is None or conn.is_closing():
                 return i
         return len(self.server_connections)
@@ -311,14 +337,21 @@ class StaticGrid2DSpatialController:
         self.server_connections[server_index] = ctx.connection
         server_index = self._next_server_index()
         if server_index == n_servers:
-            # Everyone is in: wire the interest borders, then tell all the
-            # spatial servers (and the master server) the world is ready.
+            # Everyone (this gateway hosts) is in: wire the interest
+            # borders, then tell all the local spatial servers (and the
+            # master server) the world is ready. In a federated world the
+            # remote shards' slots stay None here — their cells live on
+            # other gateways (doc/federation.md).
             for i in range(n_servers):
+                if self.server_connections[i] is None:
+                    continue
                 self._sub_to_adjacent_channels(i, sgc, sgr, msg.subOptions)
             ready = spatial_pb2.SpatialChannelsReadyMessage(
                 serverIndex=server_index, serverCount=n_servers
             )
             for conn in self.server_connections:
+                if conn is None:
+                    continue
                 conn.send(
                     MessageContext(
                         msg_type=MessageType.SPATIAL_CHANNELS_READY, msg=ready
@@ -355,6 +388,11 @@ class StaticGrid2DSpatialController:
             channel_id = self.get_channel_id_no_offset(info)
             ch = get_channel(channel_id)
             if ch is None:
+                if not _shard_directory.is_local_cell(channel_id):
+                    # Border cell in a remote shard: it has no local
+                    # channel to subscribe to. Cross-gateway interest
+                    # arrives as handover/redirect traffic instead.
+                    return
                 raise RuntimeError(f"border channel {channel_id} doesn't exist")
             cs, should_send = subscribe_to_channel(conn, ch, sub_options)
             if should_send:
@@ -418,7 +456,10 @@ class StaticGrid2DSpatialController:
             # they replay through the batched orchestration on
             # unfreeze. An entity with an ALREADY-parked crossing keeps
             # chaining into it even off-freeze: its true origin is the
-            # parked entry's.
+            # parked entry's. Checked BEFORE the remote-dst branch: a
+            # federated handover out of a frozen src cell would mutate
+            # the cell mid-migration (the packed-state bootstrap could
+            # ship an entity the trunk just moved).
             eid = handover_data_provider(-1, -1)
             if eid is not None and (
                 src_channel_id in frozen
@@ -429,6 +470,17 @@ class StaticGrid2DSpatialController:
                     eid, old_info, new_info, handover_data_provider
                 )
                 return
+        if not _shard_directory.is_local_cell(dst_channel_id):
+            # The destination cell lives on another gateway: this
+            # crossing is a cross-gateway handover — the transactional
+            # journal extended over the trunk (federation/plane.py,
+            # doc/federation.md). Never orchestrated locally.
+            from ..federation.plane import plane as _fed_plane
+
+            _fed_plane.initiate_handover(
+                src_channel_id, dst_channel_id, [handover_data_provider]
+            )
+            return
         self._orchestrate_pair(src_channel_id, dst_channel_id,
                                [handover_data_provider])
 
@@ -444,6 +496,7 @@ class StaticGrid2DSpatialController:
         measured 87.8us each (11.4K/s, scripts/bench_handover.py) — far
         under the 44.5K/s detection rate, hence this path."""
         groups: dict = {}  # insertion-ordered: first-crossing pair order
+        remote_groups: dict = {}  # (src, dst) -> providers, dst on a peer
         frozen = _balancer.frozen_cells
         for old_info, new_info, provider in crossings:
             try:
@@ -470,13 +523,25 @@ class StaticGrid2DSpatialController:
                     # Live migration in flight: park the crossing with
                     # the balancer (chains collapse per entity); it
                     # replays through this very path once the migration
-                    # commits or aborts.
+                    # commits or aborts. Outranks the remote-dst branch:
+                    # a federated handover out of a frozen src would
+                    # mutate the cell mid-migration.
                     _balancer.defer_crossing(eid, old_info, new_info,
                                              provider)
                     continue
+            if not _shard_directory.is_local_cell(d):
+                # Remote destination: batched cross-gateway handover
+                # (one trunk prepare per (src, dst) pair per tick).
+                remote_groups.setdefault((s, d), []).append(provider)
+                continue
             groups.setdefault((s, d), []).append(provider)
         for (s, d), providers in groups.items():
             self._orchestrate_pair(s, d, providers)
+        if remote_groups:
+            from ..federation.plane import plane as _fed_plane
+
+            for (s, d), providers in remote_groups.items():
+                _fed_plane.initiate_handover(s, d, providers)
 
     def _orchestrate_pair(
         self, src_channel_id: int, dst_channel_id: int, providers: list

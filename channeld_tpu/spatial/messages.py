@@ -134,6 +134,21 @@ def handle_update_spatial_interest(ctx) -> None:
     # spots queries fall through to the host path below (absolute points
     # can't follow an entity — the engine itself serves spots via
     # set_spots_query for sidecar consumers).
+    # Federation: a client following an entity is ANCHORED on it — if
+    # that entity later commits a cross-gateway handover, the client is
+    # redirected to the gateway now hosting it (doc/federation.md). The
+    # anchor applies on the host path too (the follow itself needs the
+    # device plane, but possession doesn't).
+    from ..federation.directory import directory as _fed_directory
+
+    if _fed_directory.active:
+        from ..federation.plane import plane as _fed_plane
+
+        if msg.followEntityId:
+            _fed_plane.set_client_anchor(client_conn, msg.followEntityId)
+        else:
+            _fed_plane.clear_client_anchor(client_conn.id)
+
     register = getattr(controller, "register_follow_interest", None)
     unregister = getattr(controller, "unregister_follow_interest", None)
     if callable(register):

@@ -150,6 +150,7 @@ async def run_balance_soak(p: BalanceSoakParams) -> dict:
     from channeld_tpu.core.ddos import init_anti_ddos, unauth_reaper_loop
     from channeld_tpu.core.failover import journal, plane, reset_failover
     from channeld_tpu.core.overload import reset_overload
+    from channeld_tpu.federation import reset_federation
     from channeld_tpu.core.server import flush_loop, start_listening
     from channeld_tpu.core.settings import (
         ChannelSettings,
@@ -195,6 +196,11 @@ async def run_balance_soak(p: BalanceSoakParams) -> dict:
     )
     global_settings.failover_enabled = True
     global_settings.balancer_enabled = True
+    # Federation stays pinned OFF: a remote shard would route some
+    # crossings over a trunk and break this soak's deterministic
+    # single-gateway accounting (doc/federation.md).
+    reset_federation()
+    global_settings.federation_config = ""
     global_settings.balancer_imbalance_enter = p.imbalance_enter
     global_settings.balancer_imbalance_exit = p.imbalance_exit
     global_settings.balancer_hold_ticks = p.hold_ticks

@@ -478,6 +478,7 @@ async def run_soak(p: SoakParams) -> dict:
     t_start = time.monotonic()
 
     from channeld_tpu.core.overload import reset_overload
+    from channeld_tpu.federation import reset_federation
 
     # -- fresh runtime (idempotent; the pytest smoke shares a process) --
     channel_mod.reset_channels()
@@ -493,6 +494,11 @@ async def run_soak(p: SoakParams) -> dict:
     # This soak proves the CHAOS plane: the balancer's planned migrations
     # would add nondeterministic authority moves to a seeded scenario.
     global_settings.balancer_enabled = False
+    # Federation stays pinned OFF: a remote shard would route some
+    # crossings over a trunk and break this soak's deterministic
+    # single-gateway accounting (doc/federation.md).
+    reset_federation()
+    global_settings.federation_config = ""
     global_settings.tpu_entity_capacity = p.entity_capacity
     global_settings.tpu_query_capacity = p.query_capacity
     # Tick cadences tuned for a live soak on a shared CPU box: GLOBAL

@@ -164,6 +164,7 @@ async def run_failover_soak(p: FailoverSoakParams) -> dict:
     from channeld_tpu.core.ddos import init_anti_ddos, unauth_reaper_loop
     from channeld_tpu.core.failover import journal, plane, reset_failover
     from channeld_tpu.core.overload import reset_overload
+    from channeld_tpu.federation import reset_federation
     from channeld_tpu.core.server import flush_loop, start_listening
     from channeld_tpu.core.settings import (
         ChannelSettings,
@@ -205,6 +206,11 @@ async def run_failover_soak(p: FailoverSoakParams) -> dict:
     # re-host accounting must see only CRASH-path authority moves
     # (scripts/balance_soak.py proves the planned-migration path).
     global_settings.balancer_enabled = False
+    # Federation stays pinned OFF: a remote shard would route some
+    # crossings over a trunk and break this soak's deterministic
+    # single-gateway accounting (doc/federation.md).
+    reset_federation()
+    global_settings.federation_config = ""
     global_settings.server_conn_recoverable = True
     global_settings.server_conn_recover_timeout_ms = int(
         p.recover_window_s * 1000

@@ -1,0 +1,364 @@
+"""Live-gateway fan-out decision bench: the north star's p99 < 5ms claim.
+
+The north star's second clause — p99 fan-out-decision latency < 5ms at
+BASELINE configs #4/#5 — had artifacts only for the device step in
+isolation (bench.py) until this script: here the decision pass is
+measured *through the live gateway*: real TCP master + spatial servers
+claiming the world through CREATE_CHANNEL, entities registered on the
+device plane, the GLOBAL tick driving the batched engine step, and the
+per-channel host decision loop (``tick_data``) feeding
+``fanout_decision_latency{backend="host"}``.
+
+Two measured worlds:
+
+- **config4** — ``config/spatial_tpu_benchmark.json`` (15x15 grid of
+  2000-unit cells, 3x3 servers; BASELINE #4 is 50K moving entities
+  @30Hz on this geometry).
+- **config5** — the seamless open-world shape (BASELINE #5): 16x16
+  grid, 8 spatial servers (4x2 blocks), dynamic handover across the
+  grid while a crowd jitters.
+
+Entity counts scale by CLI (``--entities``): a CPU-only host measures
+the machinery honestly at a feasible population and the artifact
+records the gap to the BASELINE targets; on a real TPU host run with
+``--entities 50000`` for the full claim.
+
+Emits ``BENCH_FANOUT_*.json``:
+  p99 fanout-decision (host loop) per config, device step p99, GLOBAL
+  tick p99, entities, platform — plus pass/fail against the 5ms bar.
+
+Run:
+  python scripts/fanout_bench.py --entities 2000 --duration 10 \
+      --out BENCH_FANOUT_r10.json
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+if os.environ.get("CHTPU_SOAK_TPU") != "1":
+    from channeld_tpu.utils.devices import pin_cpu_if_virtual_devices
+
+    pin_cpu_if_virtual_devices()
+
+import argparse
+import asyncio
+import importlib.util
+import json
+import time
+from random import Random
+
+CONFIG5 = {
+    "SpatialControllerType": "TPUSpatialController",
+    "Config": {
+        "WorldOffsetX": -16000,
+        "WorldOffsetZ": -16000,
+        "GridWidth": 2000,
+        "GridHeight": 2000,
+        "GridCols": 16,
+        "GridRows": 16,
+        # 8 spatial servers (BASELINE #5: 8 x 12.5K entities).
+        "ServerCols": 4,
+        "ServerRows": 2,
+        "ServerInterestBorderSize": 1,
+    },
+}
+
+
+def _load_chaos_soak():
+    spec = importlib.util.spec_from_file_location(
+        "chaos_soak", os.path.join(REPO, "scripts", "chaos_soak.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("chaos_soak", mod)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+async def bench_config(name: str, spec: dict, entities: int,
+                       duration_s: float, tick_ms: int) -> dict:
+    cs = _load_chaos_soak()
+    from channeld_tpu.chaos.invariants import (
+        delta,
+        histogram_quantile,
+        sample_total,
+        scrape,
+    )
+    from channeld_tpu.core import channel as channel_mod
+    from channeld_tpu.core import connection as connection_mod
+    from channeld_tpu.core import data as data_mod
+    from channeld_tpu.core import ddos as ddos_mod
+    from channeld_tpu.core import connection_recovery as recovery_mod
+    from channeld_tpu.core.channel import all_channels, init_channels
+    from channeld_tpu.core.connection import init_connections
+    from channeld_tpu.core.ddos import init_anti_ddos, unauth_reaper_loop
+    from channeld_tpu.core.failover import reset_failover
+    from channeld_tpu.core.overload import reset_overload
+    from channeld_tpu.core.server import flush_loop, start_listening
+    from channeld_tpu.core.settings import (
+        ChannelSettings,
+        global_settings,
+        reset_global_settings,
+    )
+    from channeld_tpu.core.types import (
+        ChannelDataAccess,
+        ChannelType,
+        ConnectionType,
+        MessageType,
+    )
+    from channeld_tpu.federation import reset_federation
+    from channeld_tpu.models.sim import register_sim_types
+    from channeld_tpu.protocol import control_pb2
+    from channeld_tpu.spatial.balancer import reset_balancer
+    from channeld_tpu.spatial.controller import (
+        get_spatial_controller,
+        init_spatial_controller,
+        reset_spatial_controller,
+    )
+
+    channel_mod.reset_channels()
+    connection_mod.reset_connections()
+    data_mod.reset_registries()
+    ddos_mod.reset_ddos()
+    recovery_mod.reset_recovery()
+    reset_spatial_controller()
+    reset_global_settings()
+    reset_overload()
+    reset_failover()
+    reset_balancer()
+    reset_federation()
+
+    cfg = spec["Config"]
+    n_servers = cfg["ServerCols"] * cfg["ServerRows"]
+    n_cells = cfg["GridCols"] * cfg["GridRows"]
+
+    global_settings.development = True
+    global_settings.balancer_enabled = False
+    global_settings.tpu_entity_capacity = max(1 << 10, 1 << (
+        max(entities - 1, 1).bit_length() + 1))
+    global_settings.tpu_query_capacity = 64
+    global_settings.channel_settings = {
+        ChannelType.GLOBAL: ChannelSettings(
+            tick_interval_ms=tick_ms, default_fanout_interval_ms=33),
+        ChannelType.SPATIAL: ChannelSettings(
+            tick_interval_ms=tick_ms, default_fanout_interval_ms=33),
+        ChannelType.ENTITY: ChannelSettings(
+            tick_interval_ms=50, default_fanout_interval_ms=100),
+    }
+
+    register_sim_types()
+    init_connections(
+        os.path.join(REPO, "config", "server_authoritative_fsm.json"),
+        os.path.join(REPO, "config", "client_authoritative_fsm.json"),
+    )
+    init_channels()
+    init_anti_ddos()
+
+    spec_path = os.path.join("/tmp", f"fanout_bench_{name}_{os.getpid()}.json")
+    with open(spec_path, "w") as f:
+        json.dump(spec, f)
+    init_spatial_controller(spec_path)
+    ctl = get_spatial_controller()
+
+    host = "127.0.0.1"
+    server_srv = await start_listening(ConnectionType.SERVER, "tcp",
+                                       f"{host}:0")
+    server_port = server_srv.sockets[0].getsockname()[1]
+    stop = asyncio.Event()
+    tasks = [
+        asyncio.ensure_future(flush_loop()),
+        asyncio.ensure_future(unauth_reaper_loop()),
+    ]
+    writers = []
+    try:
+        # Master + the full spatial-server fleet over real TCP.
+        m_reader, m_writer = await cs._connect(host, server_port)
+        await cs._auth_and_wait(m_reader, m_writer, "bench-master")
+        m_writer.write(cs._frame(
+            MessageType.CREATE_CHANNEL,
+            control_pb2.CreateChannelMessage(
+                channelType=ChannelType.GLOBAL).SerializeToString(),
+        ))
+        await m_writer.drain()
+        writers.append(m_writer)
+        tasks.append(asyncio.ensure_future(
+            cs._read_frames(m_reader, lambda mp: None, stop)))
+        for i in range(n_servers):
+            r, w = await cs._connect(host, server_port)
+            await cs._auth_and_wait(r, w, f"bench-spatial-{i}")
+            w.write(cs._frame(
+                MessageType.CREATE_CHANNEL,
+                control_pb2.CreateChannelMessage(
+                    channelType=ChannelType.SPATIAL,
+                    subOptions=control_pb2.ChannelSubscriptionOptions(
+                        dataAccess=ChannelDataAccess.WRITE_ACCESS,
+                    ),
+                ).SerializeToString(),
+            ))
+            await w.drain()
+            writers.append(w)
+            tasks.append(asyncio.ensure_future(
+                cs._read_frames(r, lambda mp: None, stop)))
+
+        start_id = global_settings.spatial_channel_id_start
+        end_id = global_settings.entity_channel_id_start
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            cells = [ch for cid, ch in all_channels().items()
+                     if start_id <= cid < end_id]
+            if len(cells) == n_cells and all(
+                    ch.has_owner() for ch in cells):
+                break
+            await asyncio.sleep(0.1)
+        else:
+            raise RuntimeError(f"{name}: world failed to come up")
+
+        rng = Random(0xFA7 ^ n_cells)
+        sim_params = cs.SoakParams(entities=entities, storm_size=entities // 8)
+        sim = cs.EntitySim(ctl, sim_params, rng)
+        sim.create_entities()
+        # Warmup: first engine steps compile / stabilize.
+        warm_until = time.monotonic() + 3.0
+        while time.monotonic() < warm_until:
+            sim.jitter_step()
+            await asyncio.sleep(0.1)
+
+        baseline = scrape()
+        t0 = time.monotonic()
+        storms = 0
+        while time.monotonic() - t0 < duration_s:
+            sim.jitter_step()
+            # Keep crossings flowing: a storm every ~2s (the handover
+            # share of the decision budget must be present, BASELINE #5
+            # is "dynamic handover across grid").
+            if int((time.monotonic() - t0) * 2) % 4 == 3:
+                crowd = sim.storm_gather()
+                storms += 1
+                await asyncio.sleep(0.1)
+                sim.disperse(crowd)
+            await asyncio.sleep(1.0 / 30.0)  # 30Hz driver cadence
+        measured_s = time.monotonic() - t0
+        await asyncio.sleep(0.5)
+
+        d = delta(scrape(), baseline)
+        fanout_p99_ms = histogram_quantile(
+            d, "fanout_decision_latency_seconds", 0.99, backend="host")
+        fanout_p99_ms = (fanout_p99_ms or 0.0) * 1000.0
+        fanout_p50_ms = (histogram_quantile(
+            d, "fanout_decision_latency_seconds", 0.50, backend="host")
+            or 0.0) * 1000.0
+        device_p99_ms = (histogram_quantile(
+            d, "tpu_spatial_step_seconds", 0.99) or 0.0) * 1000.0
+        tick_p99_ms = (histogram_quantile(
+            d, "channel_tick_duration", 0.99, channel_type="GLOBAL")
+            or 0.0) * 1000.0
+        decisions = int(sample_total(
+            d, "fanout_decision_latency_seconds_count", backend="host"))
+        handovers = int(sample_total(d, "handovers_total"))
+        return {
+            "name": name,
+            "grid": f"{cfg['GridCols']}x{cfg['GridRows']}",
+            "servers": n_servers,
+            "entities": entities,
+            "duration_s": round(measured_s, 2),
+            "decision_passes": decisions,
+            "handovers": handovers,
+            "storms": storms,
+            "fanout_decision_p50_ms": round(fanout_p50_ms, 3),
+            "fanout_decision_p99_ms": round(fanout_p99_ms, 3),
+            "device_step_p99_ms": round(device_p99_ms, 3),
+            "global_tick_p99_ms": round(tick_p99_ms, 3),
+            "p99_under_5ms": bool(fanout_p99_ms < 5.0),
+        }
+    finally:
+        stop.set()
+        for t in tasks:
+            t.cancel()
+        await asyncio.sleep(0)
+        for w in writers:
+            try:
+                w.close()
+            except Exception:
+                pass
+        server_srv.close()
+        channel_mod.reset_channels()
+        connection_mod.reset_connections()
+        data_mod.reset_registries()
+        ddos_mod.reset_ddos()
+        recovery_mod.reset_recovery()
+        reset_spatial_controller()
+        reset_global_settings()
+        reset_overload()
+        reset_failover()
+        reset_balancer()
+        try:
+            os.remove(spec_path)
+        except OSError:
+            pass
+
+
+async def run(args) -> dict:
+    import jax
+
+    with open(os.path.join(REPO, "config",
+                           "spatial_tpu_benchmark.json")) as f:
+        config4 = json.load(f)
+    results = [
+        await bench_config("config4_15x15_9srv", config4, args.entities,
+                           args.duration, args.tick_ms),
+        await bench_config("config5_16x16_8srv", CONFIG5, args.entities,
+                           args.duration, args.tick_ms),
+    ]
+    platform = jax.devices()[0].platform
+    report = {
+        "metric": "live_gateway_fanout_decision",
+        "claim": "north-star: p99 fanout-decision < 5ms at BASELINE "
+                 "configs #4/#5 through the live gateway",
+        "platform": platform,
+        "entities_per_config": args.entities,
+        "baseline_targets": {
+            "config4": 50_000,
+            "config5": 100_000,
+        },
+        "scaled_run": args.entities < 50_000,
+        "note": (
+            "entity population scaled to the host (run with "
+            "--entities 50000 on a TPU host for the full claim); the "
+            "decision machinery measured is the production path: live "
+            "TCP world, device engine step per GLOBAL tick, host "
+            "per-channel decision loop feeding "
+            "fanout_decision_latency{backend=host}"
+            if args.entities < 50_000 else "full-scale run"
+        ),
+        "configs": results,
+        "p99_under_5ms_all": all(r["p99_under_5ms"] for r in results),
+    }
+    return report
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--entities", type=int, default=2000)
+    ap.add_argument("--duration", type=float, default=10.0)
+    ap.add_argument("--tick-ms", type=int, default=33)
+    ap.add_argument("--out", type=str, default="")
+    args = ap.parse_args()
+    report = asyncio.run(run(args))
+    print(json.dumps(report, indent=2))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()

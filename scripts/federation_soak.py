@@ -1,0 +1,1170 @@
+"""Federation soak: two gateways jointly hosting one spatial world.
+
+The acceptance proof for the cross-gateway federation plane
+(channeld_tpu/federation, doc/federation.md). Two REAL gateway
+processes — this one in-process (full introspection) plus a ``--role
+remote`` child — share a 4x4 world split down the middle by the shard
+directory (gateway "a" hosts the left server block, "b" the right),
+connected by an authenticated trunk link:
+
+1. **boot** — both gateways bring up their shard (master + spatial
+   server through the real CREATE_CHANNEL path), the trunk handshakes,
+   a client fleet entity population spawns in "a"'s shard, and one real
+   TCP client anchors on an entity (its "pawn").
+2. **commit burst** — a crowd herds across the shard boundary: every
+   crossing becomes a cross-gateway handover (journal prepare ->
+   trunk prepare -> remote apply -> ack commit), the anchored client
+   gets a ``ClientRedirectMessage`` and follows it — reconnecting to
+   "b" resumes via the pre-staged recovery handle (shouldRecover=true,
+   RECOVERY_CHANNEL_DATA, RECOVERY_END; no fresh login).
+3. **refusal** — "b" is pinned at overload L3: the next handover burst
+   is refused with ServerBusyMessage semantics over the trunk; the
+   entities abort back to "a"'s cells, then re-offer and commit once
+   L3 clears (refusals must equal busy frames exactly).
+4. **sever mid-burst** — a burst is initiated and the trunk is aborted
+   while acks are in flight: every in-flight batch aborts
+   deterministically back to the source gateway (entities restored to
+   their src cells through the same FIFO queue), the trunk reconnects
+   with backoff, abort notices reconcile whatever "b" applied before
+   the cut (source-wins), and the parked crossings re-offer.
+5. **herd back + quiesce** — "b" drives a crowd back across the
+   boundary (the mirror-image handover path), traffic stops, both
+   planes drain, and the child writes its full report.
+
+The invariant checker asserts the PR's acceptance bar: at least one
+committed cross-gateway handover burst; the severed burst aborted
+deterministically (and the census still balances); **zero entities
+lost or duplicated across the federation** (every entity in exactly
+one cell on exactly one gateway); refusals == busy frames; the client
+redirect resumed without re-auth; and the python ledgers match
+``federation_handover_total{result}`` exactly on BOTH gateways.
+
+Emits ``SOAK_FED_*.json`` with the phase timeline, both gateways'
+ledgers/reports, the redirect transcript, and the invariant results.
+
+Run the acceptance soak (~60s of timeline):
+  python scripts/federation_soak.py --out SOAK_FED_r10.json
+
+The <60s CI smoke runs the same machinery with smaller numbers
+(tests/test_federation.py::test_federation_smoke_soak).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import argparse
+import asyncio
+import json
+import socket
+import struct
+import subprocess
+import time
+from dataclasses import dataclass, field
+from random import Random
+
+# The federation plane is a host/channel concern: both gateways run the
+# host-semantics grid controller, so neither process needs a device.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+WORLD_SPATIAL = {
+    "SpatialControllerType": "Static2DSpatialController",
+    "Config": {
+        "WorldOffsetX": -100,
+        "WorldOffsetZ": -100,
+        "GridWidth": 50,
+        "GridHeight": 50,
+        "GridCols": 4,
+        "GridRows": 4,
+        # Two server blocks: index 0 = columns 0-1 (x < 0, gateway a),
+        # index 1 = columns 2-3 (x > 0, gateway b).
+        "ServerCols": 2,
+        "ServerRows": 1,
+        "ServerInterestBorderSize": 0,
+    },
+}
+
+
+@dataclass
+class FedSoakParams:
+    entities: int = 48
+    burst: int = 12
+    refusal_burst: int = 6
+    sever_burst: int = 12
+    herd_back: int = 8
+    phase_timeout_s: float = 20.0
+    quiesce_s: float = 3.0
+    child_boot_timeout_s: float = 60.0
+    retry_after_ms: int = 300
+    heartbeat_ms: int = 200
+    trunk_timeout_ms: int = 1200
+    handover_timeout_ms: int = 1500
+    global_tick_ms: int = 20
+    seed: int = 20260803
+    out_path: str = ""
+
+
+# ---------------------------------------------------------------------------
+# shared gateway boot (both roles)
+# ---------------------------------------------------------------------------
+
+
+def _free_ports(n: int) -> list[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _fed_config(ports: dict) -> dict:
+    return {
+        "secret": "fed-soak-secret",
+        "gateways": {
+            "a": {
+                "trunk": f"127.0.0.1:{ports['a_trunk']}",
+                "client": f"127.0.0.1:{ports['a_client']}",
+                "servers": [0],
+            },
+            "b": {
+                "trunk": f"127.0.0.1:{ports['b_trunk']}",
+                "client": f"127.0.0.1:{ports['b_client']}",
+                "servers": [1],
+            },
+        },
+    }
+
+
+def _frame(msg_type: int, body: bytes, channel_id: int = 0) -> bytes:
+    from channeld_tpu.protocol import encode_packet, wire_pb2
+
+    return encode_packet(wire_pb2.Packet(messages=[wire_pb2.MessagePack(
+        channelId=channel_id, msgType=msg_type, msgBody=body,
+    )]))
+
+
+def _auth_frame(pit: str) -> bytes:
+    from channeld_tpu.core.types import MessageType
+    from channeld_tpu.protocol import control_pb2
+
+    return _frame(MessageType.AUTH, control_pb2.AuthMessage(
+        playerIdentifierToken=pit, loginToken="fed-soak",
+    ).SerializeToString())
+
+
+async def _connect(host: str, port: int):
+    return await asyncio.open_connection(host, port)
+
+
+async def _auth_and_wait(reader, writer, pit: str, timeout: float = 8.0):
+    from channeld_tpu.protocol import FrameDecoder
+
+    writer.write(_auth_frame(pit))
+    await writer.drain()
+    dec = FrameDecoder()
+    deadline = time.monotonic() + timeout
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise TimeoutError(f"auth timeout for {pit}")
+        data = await asyncio.wait_for(reader.read(65536), timeout=remaining)
+        if not data:
+            raise ConnectionError(f"closed during auth of {pit}")
+        if any(p.messages for p in dec.decode_packets(data)):
+            return
+
+
+async def _drain(reader, stop: asyncio.Event) -> None:
+    while not stop.is_set():
+        try:
+            data = await reader.read(65536)
+        except (ConnectionError, OSError):
+            return
+        if not data:
+            return
+
+
+async def boot_gateway(gw_id: str, fed_cfg: dict, params: FedSoakParams,
+                       stop: asyncio.Event):
+    """Fresh in-process gateway hosting ONE shard of the federated
+    world: reset singletons, bring up listeners, master + one spatial
+    server (the local block), arm the federation plane."""
+    from channeld_tpu.core import channel as channel_mod
+    from channeld_tpu.core import connection as connection_mod
+    from channeld_tpu.core import data as data_mod
+    from channeld_tpu.core import ddos as ddos_mod
+    from channeld_tpu.core import connection_recovery as recovery_mod
+    from channeld_tpu.core.channel import all_channels, init_channels
+    from channeld_tpu.core.connection import init_connections
+    from channeld_tpu.core.connection_recovery import connection_recovery_loop
+    from channeld_tpu.core.ddos import init_anti_ddos, unauth_reaper_loop
+    from channeld_tpu.core.failover import reset_failover
+    from channeld_tpu.core.overload import reset_overload
+    from channeld_tpu.core.server import flush_loop, start_listening
+    from channeld_tpu.core.settings import (
+        ChannelSettings,
+        global_settings,
+        reset_global_settings,
+    )
+    from channeld_tpu.core.types import (
+        ChannelDataAccess,
+        ChannelType,
+        ConnectionType,
+        MessageType,
+    )
+    from channeld_tpu.federation import init_federation, plane, reset_federation
+    from channeld_tpu.models.sim import register_sim_types
+    from channeld_tpu.protocol import control_pb2
+    from channeld_tpu.spatial.balancer import reset_balancer
+    from channeld_tpu.spatial.controller import (
+        get_spatial_controller,
+        init_spatial_controller,
+        reset_spatial_controller,
+    )
+
+    channel_mod.reset_channels()
+    connection_mod.reset_connections()
+    data_mod.reset_registries()
+    ddos_mod.reset_ddos()
+    recovery_mod.reset_recovery()
+    reset_spatial_controller()
+    reset_global_settings()
+    reset_overload()
+    reset_failover()
+    reset_balancer()
+    reset_federation()
+
+    global_settings.development = True
+    # The federation soak proves the FEDERATION plane: the balancer's
+    # migrations and the overload ladder's organic transitions would add
+    # nondeterministic authority moves (L3 is driven explicitly in the
+    # refusal phase instead).
+    global_settings.balancer_enabled = False
+    global_settings.overload_enabled = True
+    global_settings.overload_enter_thresholds = (99.0, 99.0, 99.0)
+    global_settings.overload_down_hold_s = 9999.0
+    global_settings.overload_retry_after_ms = params.retry_after_ms
+    global_settings.federation_heartbeat_ms = params.heartbeat_ms
+    global_settings.federation_trunk_timeout_ms = params.trunk_timeout_ms
+    global_settings.federation_handover_timeout_ms = params.handover_timeout_ms
+    global_settings.federation_reconnect_base_ms = 50
+    global_settings.federation_reconnect_max_ms = 500
+    global_settings.channel_settings = {
+        ChannelType.GLOBAL: ChannelSettings(
+            tick_interval_ms=params.global_tick_ms,
+            default_fanout_interval_ms=50),
+        ChannelType.SPATIAL: ChannelSettings(
+            tick_interval_ms=50, default_fanout_interval_ms=100),
+        ChannelType.ENTITY: ChannelSettings(
+            tick_interval_ms=50, default_fanout_interval_ms=100),
+    }
+
+    register_sim_types()
+    init_connections(
+        os.path.join(REPO, "config", "server_authoritative_fsm.json"),
+        os.path.join(REPO, "config", "client_authoritative_fsm.json"),
+    )
+    init_channels()
+    init_anti_ddos()
+
+    spatial_path = os.path.join(
+        "/tmp", f"fed_soak_spatial_{gw_id}_{os.getpid()}.json"
+    )
+    with open(spatial_path, "w") as f:
+        json.dump(WORLD_SPATIAL, f)
+    init_spatial_controller(spatial_path)
+    ctl = get_spatial_controller()
+
+    init_federation(fed_cfg, gw_id, ctl)
+
+    host = "127.0.0.1"
+    ports = fed_cfg["gateways"][gw_id]
+    client_port = int(ports["client"].rpartition(":")[2])
+    server_srv = await start_listening(ConnectionType.SERVER, "tcp",
+                                       f"{host}:0")
+    server_port = server_srv.sockets[0].getsockname()[1]
+    client_srv = await start_listening(ConnectionType.CLIENT, "tcp",
+                                       f"{host}:{client_port}")
+
+    tasks = [
+        asyncio.ensure_future(flush_loop()),
+        asyncio.ensure_future(unauth_reaper_loop()),
+        asyncio.ensure_future(connection_recovery_loop()),
+    ]
+
+    # Master possesses GLOBAL; one spatial server claims the local block.
+    m_reader, m_writer = await _connect(host, server_port)
+    await _auth_and_wait(m_reader, m_writer, f"fed-master-{gw_id}")
+    m_writer.write(_frame(
+        MessageType.CREATE_CHANNEL,
+        control_pb2.CreateChannelMessage(
+            channelType=ChannelType.GLOBAL).SerializeToString(),
+    ))
+    await m_writer.drain()
+    tasks.append(asyncio.ensure_future(_drain(m_reader, stop)))
+
+    s_reader, s_writer = await _connect(host, server_port)
+    await _auth_and_wait(s_reader, s_writer, f"fed-spatial-{gw_id}")
+    s_writer.write(_frame(
+        MessageType.CREATE_CHANNEL,
+        control_pb2.CreateChannelMessage(
+            channelType=ChannelType.SPATIAL,
+            subOptions=control_pb2.ChannelSubscriptionOptions(
+                dataAccess=ChannelDataAccess.WRITE_ACCESS,
+            ),
+        ).SerializeToString(),
+    ))
+    await s_writer.drain()
+    tasks.append(asyncio.ensure_future(_drain(s_reader, stop)))
+
+    # Local shard up: 8 of the 16 cells exist here and are owned.
+    start_id = global_settings.spatial_channel_id_start
+    end_id = global_settings.entity_channel_id_start
+    deadline = time.monotonic() + 20.0
+    while time.monotonic() < deadline:
+        cells = [ch for cid, ch in all_channels().items()
+                 if start_id <= cid < end_id]
+        if len(cells) == 8 and all(ch.has_owner() for ch in cells):
+            break
+        await asyncio.sleep(0.05)
+    else:
+        raise RuntimeError(f"gateway {gw_id}: local shard failed to come up")
+
+    await plane.start()
+    return {
+        "ctl": ctl,
+        "plane": plane,
+        "tasks": tasks,
+        "writers": [m_writer, s_writer],
+        "servers": [server_srv, client_srv],
+        "spatial_path": spatial_path,
+        "client_port": client_port,
+    }
+
+
+def teardown_gateway(gw) -> None:
+    from channeld_tpu.core import channel as channel_mod
+    from channeld_tpu.core import connection as connection_mod
+    from channeld_tpu.core import data as data_mod
+    from channeld_tpu.core import ddos as ddos_mod
+    from channeld_tpu.core import connection_recovery as recovery_mod
+    from channeld_tpu.core.failover import reset_failover
+    from channeld_tpu.core.overload import reset_overload
+    from channeld_tpu.core.settings import reset_global_settings
+    from channeld_tpu.federation import reset_federation
+    from channeld_tpu.spatial.balancer import reset_balancer
+    from channeld_tpu.spatial.controller import reset_spatial_controller
+
+    reset_federation()
+    for t in gw.get("tasks", []):
+        t.cancel()
+    for w in gw.get("writers", []):
+        try:
+            w.close()
+        except Exception:
+            pass
+    for s in gw.get("servers", []):
+        s.close()
+    channel_mod.reset_channels()
+    connection_mod.reset_connections()
+    data_mod.reset_registries()
+    ddos_mod.reset_ddos()
+    recovery_mod.reset_recovery()
+    reset_spatial_controller()
+    reset_global_settings()
+    reset_overload()
+    reset_failover()
+    reset_balancer()
+    try:
+        os.remove(gw.get("spatial_path", ""))
+    except OSError:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# the host-grid entity sim
+# ---------------------------------------------------------------------------
+
+
+class FedSim:
+    """Entity driver over the host grid: creates entities in the local
+    shard, moves them through the real entity-channel merge -> notify
+    path. Entities handed to the peer vanish locally (their channels
+    are torn down on commit) and drop out of the drive set."""
+
+    def __init__(self, ctl, rng: Random):
+        self.ctl = ctl
+        self.rng = rng
+        self.entity_ids: list[int] = []
+
+    def local_ids(self) -> list[int]:
+        from channeld_tpu.core.channel import get_channel
+
+        return [e for e in self.entity_ids if get_channel(e) is not None]
+
+    def adopt_scan(self) -> None:
+        """Pick up entities the federation plane adopted from the peer
+        (remote role): any local entity channel not yet driven."""
+        from channeld_tpu.core.channel import all_channels
+        from channeld_tpu.core.settings import global_settings
+
+        known = set(self.entity_ids)
+        estart = global_settings.entity_channel_id_start
+        for cid in all_channels():
+            if cid > estart and cid not in known:
+                self.entity_ids.append(cid)
+
+    def create_entities(self, n: int, x0: float, x1: float,
+                        z0: float, z1: float) -> None:
+        from channeld_tpu.core.channel import create_entity_channel, get_channel
+        from channeld_tpu.core.settings import global_settings
+        from channeld_tpu.core.subscription import subscribe_to_channel
+        from channeld_tpu.models import sim_pb2
+        from channeld_tpu.spatial.controller import SpatialInfo
+
+        estart = global_settings.entity_channel_id_start
+        for i in range(n):
+            eid = estart + 1 + i
+            x = self.rng.uniform(x0, x1)
+            z = self.rng.uniform(z0, z1)
+            cell_ch = get_channel(
+                self.ctl.get_channel_id(SpatialInfo(x, 0, z)))
+            owner = cell_ch.get_owner()
+            ch = create_entity_channel(eid, owner)
+            d = sim_pb2.SimEntityChannelData()
+            d.state.entityId = eid
+            d.state.transform.position.x = x
+            d.state.transform.position.z = z
+            ch.init_data(d, None)
+            ch.spatial_notifier = self.ctl
+            if owner is not None:
+                subscribe_to_channel(owner, ch, None)
+            cell_ch.execute(
+                lambda c, e=eid, dd=d: c.get_data_message().add_entity(e, dd)
+            )
+            self.entity_ids.append(eid)
+
+    def move(self, eid: int, x: float, z: float) -> bool:
+        from channeld_tpu.core.channel import get_channel
+        from channeld_tpu.models import sim_pb2
+
+        ch = get_channel(eid)
+        if ch is None or ch.is_removing():
+            return False
+        upd = sim_pb2.SimEntityChannelData()
+        upd.state.entityId = eid
+        upd.state.transform.position.x = x
+        upd.state.transform.position.z = z
+
+        def _apply(c, u=upd):
+            owner = c.get_owner()
+            c.data.on_update(
+                u, c.get_time(), owner.id if owner is not None else 0,
+                self.ctl,
+            )
+
+        ch.execute(_apply)
+        return True
+
+    def herd(self, ids: list[int], x0: float, x1: float,
+             z0: float, z1: float) -> list[int]:
+        moved = []
+        for eid in ids:
+            if self.move(eid, self.rng.uniform(x0, x1),
+                         self.rng.uniform(z0, z1)):
+                moved.append(eid)
+        return moved
+
+    def jitter(self, x0: float, x1: float, z0: float, z1: float) -> None:
+        ids = self.local_ids()
+        for eid in self.rng.sample(ids, max(1, len(ids) // 6)):
+            self.move(eid, self.rng.uniform(x0, x1),
+                      self.rng.uniform(z0, z1))
+
+
+def local_placement() -> dict[str, int]:
+    """entity id -> cell channel id, over every LOCAL spatial cell (a
+    duplicate within one gateway shows as the last cell but is caught
+    by the count census below)."""
+    from channeld_tpu.core.channel import all_channels
+    from channeld_tpu.core.settings import global_settings
+
+    start_id = global_settings.spatial_channel_id_start
+    end_id = global_settings.entity_channel_id_start
+    placement: dict[str, int] = {}
+    counts: dict[int, int] = {}
+    for cid, ch in all_channels().items():
+        if not (start_id <= cid < end_id):
+            continue
+        ents = getattr(ch.get_data_message(), "entities", None)
+        if ents is None:
+            continue
+        for eid in ents:
+            placement[str(eid)] = cid
+            counts[eid] = counts.get(eid, 0) + 1
+    dups = sorted(e for e, n in counts.items() if n > 1)
+    if dups:
+        placement["__local_dups__"] = dups  # type: ignore[assignment]
+    return placement
+
+
+def fed_metric_delta(baseline: dict) -> dict:
+    """federation_handover_total{result} deltas from the in-process
+    prometheus registry (the ledger's double-entry far side)."""
+    from channeld_tpu.chaos.invariants import delta, scrape
+
+    out = {}
+    for (name, labels), value in delta(scrape(), baseline).items():
+        if name == "federation_handover_total" and value:
+            out[dict(labels)["result"]] = int(value)
+    return out
+
+
+def trunk_metrics(baseline: dict) -> dict:
+    """trunk_msgs_total{direction}, redirects_total, trunk_rtt_ms
+    quantiles — the tentpole's observability families."""
+    from channeld_tpu.chaos.invariants import (
+        delta,
+        histogram_quantile,
+        sample_total,
+        scrape,
+    )
+
+    d = delta(scrape(), baseline)
+    return {
+        "trunk_msgs_out": int(sample_total(d, "trunk_msgs_total",
+                                           direction="out")),
+        "trunk_msgs_in": int(sample_total(d, "trunk_msgs_total",
+                                          direction="in")),
+        "redirects_total": int(sample_total(d, "redirects_total")),
+        "trunk_rtt_ms_p50": histogram_quantile(d, "trunk_rtt_ms", 0.50),
+        "trunk_rtt_ms_p99": histogram_quantile(d, "trunk_rtt_ms", 0.99),
+    }
+
+
+# ---------------------------------------------------------------------------
+# remote role (gateway "b"): a child process driven over stdin
+# ---------------------------------------------------------------------------
+
+
+async def remote_main(args) -> None:
+    from channeld_tpu.chaos.invariants import scrape
+    from channeld_tpu.core.failover import journal
+    from channeld_tpu.core.overload import governor
+
+    with open(args.config) as f:
+        fed_cfg = json.load(f)
+    p = FedSoakParams(
+        retry_after_ms=args.retry_after_ms,
+        heartbeat_ms=args.heartbeat_ms,
+        trunk_timeout_ms=args.trunk_timeout_ms,
+        handover_timeout_ms=args.handover_timeout_ms,
+    )
+    stop = asyncio.Event()
+    gw = await boot_gateway("b", fed_cfg, p, stop)
+    plane = gw["plane"]
+    ctl = gw["ctl"]
+    rng = Random(args.seed ^ 0xB)
+    sim = FedSim(ctl, rng)
+    baseline = scrape()
+    print("READY", flush=True)
+
+    async def _jitter_loop():
+        while not stop.is_set():
+            sim.adopt_scan()
+            if sim.local_ids():
+                sim.jitter(2.0, 98.0, -98.0, 98.0)  # stay inside shard b
+            await asyncio.sleep(0.15)
+
+    jitter_task = asyncio.ensure_future(_jitter_loop())
+
+    loop = asyncio.get_running_loop()
+    reader = asyncio.StreamReader()
+    await loop.connect_read_pipe(
+        lambda: asyncio.StreamReaderProtocol(reader), sys.stdin
+    )
+    while True:
+        line = await reader.readline()
+        if not line:
+            break
+        try:
+            cmd = json.loads(line)
+        except ValueError:
+            continue
+        name = cmd.get("cmd")
+        if name == "force_l3":
+            governor._move(3)
+            print("OK force_l3", flush=True)
+        elif name == "clear_l3":
+            governor._move(0, forced=True)
+            print("OK clear_l3", flush=True)
+        elif name == "herd_back":
+            sim.adopt_scan()
+            ids = sim.local_ids()[: int(cmd.get("n", 8))]
+            moved = sim.herd(ids, -98.0, -2.0, -98.0, 98.0)
+            print(f"OK herd_back {len(moved)}", flush=True)
+        elif name == "quiesce":
+            stop_jitter = time.monotonic() + float(cmd.get("drain_s", 10.0))
+            jitter_task.cancel()
+            while time.monotonic() < stop_jitter and (
+                plane._pending or plane._parked
+                or journal.in_flight_count()
+            ):
+                await asyncio.sleep(0.1)
+            print("OK quiesce", flush=True)
+        elif name == "report":
+            report = {
+                "gateway": "b",
+                "ledger": dict(plane.ledger),
+                "busy_frames": plane.busy_frames,
+                "metric_delta": fed_metric_delta(baseline),
+                "trunk": trunk_metrics(baseline),
+                "placement": local_placement(),
+                "pending": len(plane._pending),
+                "parked": len(plane._parked),
+                "journal": journal.report(),
+                "events": plane.events[-200:],
+                "overload_transitions": governor.transitions,
+            }
+            with open(args.report, "w") as f:
+                json.dump(report, f)
+            print("OK report", flush=True)
+        elif name == "exit":
+            break
+    stop.set()
+    jitter_task.cancel()
+    teardown_gateway(gw)
+
+
+# ---------------------------------------------------------------------------
+# redirect-following client (a real TCP client of gateway "a")
+# ---------------------------------------------------------------------------
+
+
+async def redirect_client(host: str, port: int, pit: str,
+                          result: dict, stop: asyncio.Event) -> None:
+    """Connect to gateway a, wait for a ClientRedirectMessage, follow it
+    to gateway b, and record whether the resume was seamless."""
+    from channeld_tpu.core.types import MessageType
+    from channeld_tpu.protocol import FrameDecoder, control_pb2
+
+    reader, writer = await _connect(host, port)
+    await _auth_and_wait(reader, writer, pit)
+    result["authed_a"] = True
+    dec = FrameDecoder()
+    redirect = None
+    while redirect is None and not stop.is_set():
+        try:
+            data = await asyncio.wait_for(reader.read(65536), timeout=0.5)
+        except asyncio.TimeoutError:
+            continue
+        except (ConnectionError, OSError):
+            break
+        if not data:
+            break
+        for packet in dec.decode_packets(data):
+            for mp in packet.messages:
+                if mp.msgType == MessageType.CLIENT_REDIRECT:
+                    redirect = control_pb2.ClientRedirectMessage()
+                    redirect.ParseFromString(mp.msgBody)
+    try:
+        writer.close()
+    except Exception:
+        pass
+    if redirect is None:
+        result["redirected"] = False
+        return
+    result["redirected"] = True
+    result["redirect"] = {
+        "gateway": redirect.gatewayId,
+        "addr": redirect.addr,
+        "entity": redirect.entityId,
+        "channel": redirect.channelId,
+    }
+    # Follow: same PIT, no fresh login semantics — the staged handle
+    # makes this a RECOVERY on the destination.
+    r_host, _, r_port = redirect.addr.rpartition(":")
+    reader2, writer2 = await _connect(r_host or host, int(r_port))
+    writer2.write(_auth_frame(pit))
+    await writer2.drain()
+    dec2 = FrameDecoder()
+    deadline = time.monotonic() + 10.0
+    recovery_channels = []
+    while time.monotonic() < deadline:
+        try:
+            data = await asyncio.wait_for(reader2.read(65536), timeout=1.0)
+        except asyncio.TimeoutError:
+            continue
+        except (ConnectionError, OSError):
+            break
+        if not data:
+            break
+        done = False
+        for packet in dec2.decode_packets(data):
+            for mp in packet.messages:
+                if mp.msgType == MessageType.AUTH:
+                    ar = control_pb2.AuthResultMessage()
+                    ar.ParseFromString(mp.msgBody)
+                    result["auth_result_b"] = int(ar.result)
+                    result["should_recover"] = bool(ar.shouldRecover)
+                    result["conn_id_b"] = ar.connId
+                elif mp.msgType == MessageType.RECOVERY_CHANNEL_DATA:
+                    rm = control_pb2.ChannelDataRecoveryMessage()
+                    rm.ParseFromString(mp.msgBody)
+                    recovery_channels.append(rm.channelId)
+                elif mp.msgType == MessageType.RECOVERY_END:
+                    result["recovery_end"] = True
+                    done = True
+        if done:
+            break
+    result["recovery_channels"] = recovery_channels
+    try:
+        writer2.close()
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# the soak (gateway "a" in-process, gateway "b" as a child)
+# ---------------------------------------------------------------------------
+
+
+class Child:
+    def __init__(self, proc: subprocess.Popen):
+        self.proc = proc
+
+    async def _readline(self, timeout: float) -> str:
+        loop = asyncio.get_running_loop()
+        return await asyncio.wait_for(
+            loop.run_in_executor(None, self.proc.stdout.readline), timeout
+        )
+
+    async def wait_for(self, prefix: str, timeout: float) -> str:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            line = await self._readline(deadline - time.monotonic())
+            if not line:
+                raise RuntimeError("federation child died")
+            line = line.strip()
+            if line.startswith(prefix):
+                return line
+        raise TimeoutError(f"child never answered {prefix!r}")
+
+    async def cmd(self, name: str, timeout: float = 15.0, **kw) -> str:
+        self.proc.stdin.write(json.dumps({"cmd": name, **kw}) + "\n")
+        self.proc.stdin.flush()
+        return await self.wait_for(f"OK {name}", timeout)
+
+
+async def run_fed_soak(p: FedSoakParams) -> dict:
+    from channeld_tpu.chaos.invariants import InvariantChecker, scrape
+    from channeld_tpu.core.connection import all_connections
+    from channeld_tpu.core.failover import journal
+
+    t_start = time.monotonic()
+    ports = dict(zip(
+        ("a_trunk", "a_client", "b_trunk", "b_client"), _free_ports(4)
+    ))
+    fed_cfg = _fed_config(ports)
+    cfg_path = os.path.join("/tmp", f"fed_soak_cfg_{os.getpid()}.json")
+    report_path = os.path.join("/tmp", f"fed_soak_report_{os.getpid()}.json")
+    with open(cfg_path, "w") as f:
+        json.dump(fed_cfg, f)
+
+    child_proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--role", "remote",
+         "--config", cfg_path, "--report", report_path,
+         "--seed", str(p.seed),
+         "--retry-after-ms", str(p.retry_after_ms),
+         "--heartbeat-ms", str(p.heartbeat_ms),
+         "--trunk-timeout-ms", str(p.trunk_timeout_ms),
+         "--handover-timeout-ms", str(p.handover_timeout_ms)],
+        cwd=REPO, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    child = Child(child_proc)
+
+    stop = asyncio.Event()
+    gw = None
+    timeline: list[dict] = []
+    notes: list[str] = []
+
+    def mark(phase: str, **kw) -> None:
+        timeline.append({
+            "t": round(time.monotonic() - t_start, 2), "phase": phase, **kw
+        })
+
+    try:
+        await child.wait_for("READY", p.child_boot_timeout_s)
+        gw = await boot_gateway("a", fed_cfg, p, stop)
+        plane = gw["plane"]
+        ctl = gw["ctl"]
+        baseline = scrape()
+
+        # Trunk up ("a" dials "b").
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline and plane.link_to("b") is None:
+            await asyncio.sleep(0.05)
+        if plane.link_to("b") is None:
+            raise RuntimeError("trunk to b never came up")
+        mark("trunk_up")
+
+        rng = Random(p.seed ^ 0xA)
+        sim = FedSim(ctl, rng)
+        # All entities start in a's shard (x < 0).
+        sim.create_entities(p.entities, -98.0, -2.0, -98.0, 98.0)
+        await asyncio.sleep(0.5)
+
+        # The anchored client (a real TCP session on gateway a).
+        redirect_result: dict = {}
+        anchor_eid = sim.entity_ids[0]
+        client_task = asyncio.ensure_future(redirect_client(
+            "127.0.0.1", gw["client_port"], "fed-client-0",
+            redirect_result, stop,
+        ))
+        cdeadline = time.monotonic() + 10.0
+        anchor_conn = None
+        while time.monotonic() < cdeadline and anchor_conn is None:
+            for conn in all_connections().values():
+                if getattr(conn, "pit", "") == "fed-client-0" \
+                        and not conn.is_closing():
+                    anchor_conn = conn
+                    break
+            await asyncio.sleep(0.05)
+        if anchor_conn is None:
+            raise RuntimeError("anchored client never authed")
+        plane.set_client_anchor(anchor_conn, anchor_eid)
+
+        async def wait_ledger(key: str, at_least: int, timeout: float) -> bool:
+            end = time.monotonic() + timeout
+            while time.monotonic() < end:
+                if plane.ledger.get(key, 0) >= at_least:
+                    return True
+                await asyncio.sleep(0.05)
+            return False
+
+        # ---- phase 1: commit burst (includes the anchor entity) ----
+        burst_ids = sim.entity_ids[: p.burst]
+        sim.herd(burst_ids, 2.0, 98.0, -98.0, 98.0)
+        ok = await wait_ledger("committed", p.burst, p.phase_timeout_s)
+        if not ok:
+            notes.append(
+                f"commit burst incomplete: {plane.ledger.get('committed', 0)}"
+                f"/{p.burst}"
+            )
+        committed_burst = plane.ledger.get("committed", 0)
+        mark("commit_burst", committed=committed_burst)
+
+        # Redirect follows asynchronously; give it a bounded window.
+        rdeadline = time.monotonic() + p.phase_timeout_s
+        while time.monotonic() < rdeadline \
+                and not redirect_result.get("recovery_end"):
+            await asyncio.sleep(0.1)
+        mark("redirect", **{
+            k: v for k, v in redirect_result.items() if k != "recovery_channels"
+        })
+
+        # ---- phase 2: refusal under destination L3 ----
+        await child.cmd("force_l3")
+        refusal_ids = sim.local_ids()[: p.refusal_burst]
+        sim.herd(refusal_ids, 2.0, 98.0, -98.0, 98.0)
+        ok = await wait_ledger("refused", 1, p.phase_timeout_s)
+        if not ok:
+            notes.append("no refusal observed under destination L3")
+        refused_batches = plane.ledger.get("refused", 0)
+        aborted_at_refusal = plane.ledger.get("aborted", 0)
+        await child.cmd("clear_l3")
+        # Parked entities re-offer after retryAfterMs and commit.
+        ok = await wait_ledger(
+            "committed", committed_burst + len(refusal_ids),
+            p.phase_timeout_s,
+        )
+        if not ok:
+            notes.append("refused entities never re-committed after L3 clear")
+        mark("refusal", refused=refused_batches,
+             busy_frames=plane.busy_frames)
+
+        # ---- phase 3: sever mid-burst ----
+        sever_ids = sim.local_ids()[: p.sever_burst]
+        committed_before_sever = plane.ledger.get("committed", 0)
+        aborted_before_sever = plane.ledger.get("aborted", 0)
+        sim.herd(sever_ids, 2.0, 98.0, -98.0, 98.0)
+        sdeadline = time.monotonic() + 5.0
+        severed = False
+        while time.monotonic() < sdeadline:
+            link = plane.link_to("b")
+            if plane._pending and link is not None:
+                link.sever_for_test()
+                severed = True
+                break
+            if not plane._pending and plane.ledger.get(
+                    "committed", 0) >= committed_before_sever + len(sever_ids):
+                break  # all acks won the race
+            await asyncio.sleep(0)
+        if not severed:
+            notes.append("sever raced: no batch in flight at cut time")
+        # Reconnect + reconcile + re-offer: everything drains.
+        ddeadline = time.monotonic() + p.phase_timeout_s * 2
+        while time.monotonic() < ddeadline and (
+            plane._pending or plane._parked
+        ):
+            await asyncio.sleep(0.1)
+        mark("sever",
+             severed=severed,
+             aborted=plane.ledger.get("aborted", 0) - aborted_before_sever,
+             pending_after=len(plane._pending),
+             parked_after=len(plane._parked))
+
+        # ---- phase 4: herd back (b initiates, a receives) ----
+        await child.cmd("herd_back", n=p.herd_back)
+        ok = await wait_ledger("applied", 1, p.phase_timeout_s)
+        if not ok:
+            notes.append("no b->a handover applied")
+        mark("herd_back", applied=plane.ledger.get("applied", 0))
+
+        # ---- quiesce + census ----
+        await child.cmd("quiesce", timeout=p.phase_timeout_s + 5.0,
+                        drain_s=p.phase_timeout_s)
+        qdeadline = time.monotonic() + p.phase_timeout_s
+        while time.monotonic() < qdeadline and (
+            plane._pending or plane._parked or journal.in_flight_count()
+        ):
+            await asyncio.sleep(0.1)
+        await asyncio.sleep(p.quiesce_s)
+        await child.cmd("report", timeout=15.0)
+        with open(report_path) as f:
+            b_report = json.load(f)
+
+        a_placement = local_placement()
+        b_placement = dict(b_report["placement"])
+        local_dups_a = a_placement.pop("__local_dups__", [])
+        local_dups_b = b_placement.pop("__local_dups__", [])
+
+        inv = InvariantChecker()
+
+        # 1. At least one committed cross-gateway handover burst.
+        inv.expect_gt("cross_gateway_handovers_committed",
+                      plane.ledger.get("committed", 0), 0)
+        inv.expect_le("commit_burst_reached_target",
+                      p.burst, committed_burst,
+                      f"burst committed {committed_burst}/{p.burst}")
+
+        # 2. The severed burst aborted deterministically back to a.
+        inv.check("trunk_severed_mid_burst", severed, str(notes))
+        inv.expect_gt("sever_aborted_back_to_source",
+                      plane.ledger.get("aborted", 0), 0)
+        inv.expect_equal("nothing_left_in_flight",
+                         (len(plane._pending), len(plane._parked),
+                          b_report["pending"], b_report["parked"]),
+                         (0, 0, 0, 0))
+
+        # 3. Zero entities lost or duplicated ACROSS the federation.
+        counts: dict[str, list] = {}
+        for eid, cell in a_placement.items():
+            counts.setdefault(eid, []).append(("a", cell))
+        for eid, cell in b_placement.items():
+            counts.setdefault(eid, []).append(("b", cell))
+        expected = {str(e) for e in sim.entity_ids}
+        missing = sorted(e for e in expected if e not in counts)
+        duplicated = {e: where for e, where in counts.items()
+                      if len(where) > 1}
+        unexpected = sorted(e for e in counts if e not in expected)
+        inv.expect_equal("every_entity_on_exactly_one_gateway",
+                         (missing, duplicated, unexpected,
+                          local_dups_a, local_dups_b),
+                         ([], {}, [], [], []))
+
+        # 4. Refusals == busy frames, on both sides of the trunk.
+        inv.expect_gt("l3_refusal_fired", refused_batches, 0)
+        inv.expect_equal("refusals_equal_busy_frames",
+                         plane.ledger.get("refused", 0), plane.busy_frames)
+        inv.expect_equal("remote_refusals_match",
+                         b_report["ledger"].get("refused_remote", 0),
+                         plane.ledger.get("refused", 0))
+
+        # 5. Client redirect resumed without re-auth.
+        inv.check("client_redirected",
+                  redirect_result.get("redirected", False),
+                  str(redirect_result))
+        inv.check(
+            "redirect_resumed_without_reauth",
+            redirect_result.get("should_recover", False)
+            and redirect_result.get("auth_result_b", -1) == 0
+            and redirect_result.get("recovery_end", False),
+            str(redirect_result),
+        )
+
+        # 6. Double-entry accounting: python ledger == prometheus, both
+        #    gateways; a's commits == b's applies minus reconciles.
+        a_metric = fed_metric_delta(baseline)
+        a_ledger_counters = {
+            k: v for k, v in plane.ledger.items()
+            if k not in ("redirects", "staged")
+        }
+        inv.expect_equal("a_ledger_matches_metric",
+                         a_metric, a_ledger_counters)
+        b_ledger_counters = {
+            k: v for k, v in b_report["ledger"].items()
+            if k not in ("redirects", "staged")
+        }
+        inv.expect_equal("b_ledger_matches_metric",
+                         b_report["metric_delta"], b_ledger_counters)
+        # Cross-gateway double entry: what a committed is exactly what
+        # b kept (applied minus the source-wins reconciles), and vice
+        # versa for the herd-back direction.
+        inv.expect_equal(
+            "a_commits_equal_b_applies_minus_reconciled",
+            plane.ledger.get("committed", 0),
+            b_report["ledger"].get("applied", 0)
+            - b_report["ledger"].get("reconciled", 0),
+        )
+        inv.expect_equal(
+            "b_commits_equal_a_applies_minus_reconciled",
+            b_report["ledger"].get("committed", 0),
+            plane.ledger.get("applied", 0)
+            - plane.ledger.get("reconciled", 0),
+        )
+
+        # 7. Journal balances on the initiator; nothing in flight.
+        jc = dict(journal.counts)
+        inv.expect_equal(
+            "journal_prepared_equals_committed_plus_aborted",
+            jc.get("prepared", 0),
+            jc.get("committed", 0) + jc.get("aborted", 0),
+            f"counts={jc}",
+        )
+        inv.expect_equal("journal_nothing_in_flight",
+                         journal.in_flight_count(), 0)
+
+        report = {
+            "kind": "federation_soak",
+            "duration_s": round(time.monotonic() - t_start, 2),
+            "entities": p.entities,
+            "phases": {
+                "burst": p.burst,
+                "refusal_burst": p.refusal_burst,
+                "sever_burst": p.sever_burst,
+                "herd_back": p.herd_back,
+            },
+            "knobs": {
+                "retry_after_ms": p.retry_after_ms,
+                "heartbeat_ms": p.heartbeat_ms,
+                "trunk_timeout_ms": p.trunk_timeout_ms,
+                "handover_timeout_ms": p.handover_timeout_ms,
+            },
+            "directory": fed_cfg,
+            "timeline": timeline,
+            "redirect": redirect_result,
+            "gateway_a": {
+                "ledger": dict(plane.ledger),
+                "busy_frames": plane.busy_frames,
+                "metric_delta": a_metric,
+                "trunk": trunk_metrics(baseline),
+                "journal": journal.report(),
+                "events": plane.events[-200:],
+            },
+            "gateway_b": b_report,
+            "census": {
+                "expected": len(expected),
+                "on_a": len(a_placement),
+                "on_b": len(b_placement),
+                "missing": missing,
+                "duplicated": {
+                    str(k): v for k, v in duplicated.items()
+                },
+            },
+            "invariants": inv.summary(),
+            "stats": {
+                "committed": plane.ledger.get("committed", 0),
+                "aborted": plane.ledger.get("aborted", 0),
+                "refused": plane.ledger.get("refused", 0),
+                "applied_from_b": plane.ledger.get("applied", 0),
+                "b_applied": b_report["ledger"].get("applied", 0),
+                "b_reconciled": b_report["ledger"].get("reconciled", 0),
+                "redirects": plane.ledger.get("redirects", 0),
+            },
+        }
+        if notes:
+            report["notes"] = notes
+        if p.out_path:
+            with open(p.out_path, "w") as f:
+                json.dump(report, f, indent=2)
+        stop.set()
+        client_task.cancel()
+        return report
+    finally:
+        stop.set()
+        try:
+            if child_proc.poll() is None:
+                try:
+                    child_proc.stdin.write('{"cmd": "exit"}\n')
+                    child_proc.stdin.flush()
+                except (BrokenPipeError, OSError):
+                    pass
+                try:
+                    child_proc.wait(timeout=8)
+                except subprocess.TimeoutExpired:
+                    child_proc.kill()
+        except Exception:
+            pass
+        if gw is not None:
+            teardown_gateway(gw)
+        for path in (cfg_path, report_path):
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--role", choices=("soak", "remote"), default="soak")
+    ap.add_argument("--config", type=str, default="")
+    ap.add_argument("--report", type=str, default="")
+    ap.add_argument("--seed", type=int, default=20260803)
+    ap.add_argument("--entities", type=int, default=48)
+    ap.add_argument("--burst", type=int, default=12)
+    ap.add_argument("--refusal-burst", type=int, default=6)
+    ap.add_argument("--sever-burst", type=int, default=12)
+    ap.add_argument("--herd-back", type=int, default=8)
+    ap.add_argument("--retry-after-ms", type=int, default=300)
+    ap.add_argument("--heartbeat-ms", type=int, default=200)
+    ap.add_argument("--trunk-timeout-ms", type=int, default=1200)
+    ap.add_argument("--handover-timeout-ms", type=int, default=1500)
+    ap.add_argument("--out", type=str, default="")
+    args = ap.parse_args()
+    if args.role == "remote":
+        asyncio.run(remote_main(args))
+        return
+    p = FedSoakParams(entities=args.entities, seed=args.seed,
+                      burst=args.burst, refusal_burst=args.refusal_burst,
+                      sever_burst=args.sever_burst,
+                      herd_back=args.herd_back,
+                      retry_after_ms=args.retry_after_ms,
+                      heartbeat_ms=args.heartbeat_ms,
+                      trunk_timeout_ms=args.trunk_timeout_ms,
+                      handover_timeout_ms=args.handover_timeout_ms,
+                      out_path=args.out)
+    report = asyncio.run(run_fed_soak(p))
+    slim = dict(report)
+    slim["gateway_b"] = {k: v for k, v in report["gateway_b"].items()
+                         if k not in ("events", "placement")}
+    slim["gateway_a"] = {k: v for k, v in report["gateway_a"].items()
+                         if k != "events"}
+    print(json.dumps(slim, indent=2))
+    if not report["invariants"]["ok"]:
+        raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()

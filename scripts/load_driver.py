@@ -107,9 +107,50 @@ def _count_frames(buf: bytearray) -> int:
     return count
 
 
+def _pop_frames(buf: bytearray) -> list[tuple[int, bytes]]:
+    """Consume complete frames; return (compression, body) pairs. Only
+    used in --follow-redirects mode (the default path counts tags
+    without materializing bodies)."""
+    out = []
+    pos = 0
+    n = len(buf)
+    while n - pos >= HEADER:
+        size = (buf[pos + 2] << 8) | buf[pos + 3]
+        if n - pos < HEADER + size:
+            break
+        out.append((buf[pos + 4], bytes(buf[pos + HEADER:pos + HEADER + size])))
+        pos += HEADER + size
+    del buf[:pos]
+    return out
+
+
+def _find_redirect(ct: int, body: bytes):
+    """ClientRedirectMessage in one frame body, or None. Compressed
+    frames are skipped (the driver runs the gateway uncompressed)."""
+    if ct:
+        return None
+    from channeld_tpu.core.types import MessageType
+    from channeld_tpu.protocol import control_pb2, wire_pb2
+
+    try:
+        packet = wire_pb2.Packet()
+        packet.ParseFromString(body)
+    except Exception:
+        return None
+    for mp in packet.messages:
+        if mp.msgType == MessageType.CLIENT_REDIRECT:
+            msg = control_pb2.ClientRedirectMessage()
+            try:
+                msg.ParseFromString(mp.msgBody)
+            except Exception:
+                return None
+            return msg
+    return None
+
+
 class _Conn:
     __slots__ = ("sock", "rbuf", "obuf", "authed", "closed", "frames_in",
-                 "blocked", "pending")
+                 "blocked", "pending", "auth_frame", "redirects")
 
     def __init__(self, sock):
         self.sock = sock
@@ -120,6 +161,8 @@ class _Conn:
         self.frames_in = 0
         self.blocked = 0
         self.pending = ()  # (sub_frame, update_frame)
+        self.auth_frame = b""  # kept for --follow-redirects re-auth
+        self.redirects = 0
 
     def try_send(self, frame: bytes) -> bool:
         """Frame-atomic non-blocking send: a partial write stashes the
@@ -156,14 +199,53 @@ class _Conn:
         return True
 
 
+def _do_redirect(c: _Conn, msg, sel) -> bool:
+    """Follow a ClientRedirectMessage: reconnect to the named gateway
+    with the SAME PIT — the destination's pre-staged recovery handle
+    resumes the session (subs restored server-side; no SUB re-issue).
+    Synchronous on purpose: redirects are rare control-plane events, and
+    the staged handle makes the far side answer immediately."""
+    try:
+        sel.unregister(c.sock)
+    except (KeyError, ValueError):
+        pass
+    try:
+        c.sock.close()
+    except OSError:
+        pass
+    host, _, port = msg.addr.rpartition(":")
+    try:
+        s = socket.create_connection((host or "127.0.0.1", int(port)),
+                                     timeout=5)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        s.sendall(c.auth_frame)
+        s.settimeout(5)
+        buf = bytearray()
+        while _count_frames(bytearray(buf)) == 0:  # peek-count, keep bytes
+            data = s.recv(65536)
+            if not data:
+                raise ConnectionError("closed during redirect re-auth")
+            buf.extend(data)
+    except (OSError, ConnectionError):
+        c.closed = True
+        return False
+    s.setblocking(False)
+    c.sock = s
+    c.rbuf = bytearray()
+    c.obuf = bytearray()
+    c.redirects += 1
+    sel.register(s, selectors.EVENT_READ, c)
+    return True
+
+
 def worker(worker_id: int, addr: str, n_conns: int, rate: float,
            duration: float, connect_stagger: float, mode: str,
-           result_queue) -> None:
+           result_queue, follow_redirects: bool = False) -> None:
     """Process entry: a crash must still report (main would otherwise
     block forever on the result queue)."""
     try:
         _worker(worker_id, addr, n_conns, rate, duration, connect_stagger,
-                mode, result_queue)
+                mode, result_queue, follow_redirects)
     except Exception as e:  # noqa: BLE001 - report, don't hang the bench
         result_queue.put({
             "worker": worker_id, "conns": 0, "authed": 0, "sent": 0,
@@ -174,7 +256,7 @@ def worker(worker_id: int, addr: str, n_conns: int, rate: float,
 
 def _worker(worker_id: int, addr: str, n_conns: int, rate: float,
             duration: float, connect_stagger: float, mode: str,
-            result_queue) -> None:
+            result_queue, follow_redirects: bool = False) -> None:
     # The gateway must win CPU contention: workers only need to keep the
     # sockets fed (they send precomputed bytes), so they run maximally
     # nice'd — essential on small hosts where driver and gateway share
@@ -204,6 +286,7 @@ def _worker(worker_id: int, addr: str, n_conns: int, rate: float,
             continue
         c = _Conn(s)
         c.pending = (sub, update)  # type: ignore[attr-defined]
+        c.auth_frame = auth
         conns.append(c)
         s.setblocking(False)
         sel.register(s, selectors.EVENT_READ, c)
@@ -285,7 +368,18 @@ def _worker(worker_id: int, addr: str, n_conns: int, rate: float,
                 c.closed = True
                 continue
             c.rbuf.extend(data)
-            c.frames_in += _count_frames(c.rbuf)
+            if not follow_redirects:
+                c.frames_in += _count_frames(c.rbuf)
+            else:
+                # Federation mode: bodies are decoded so a
+                # ClientRedirectMessage can steer this connection to the
+                # gateway now hosting its interest (doc/federation.md).
+                for ct, body in _pop_frames(c.rbuf):
+                    c.frames_in += 1
+                    redirect = _find_redirect(ct, body)
+                    if redirect is not None:
+                        _do_redirect(c, redirect, sel)
+                        break
     elapsed = time.time() - t_start
 
     frames_in_total = sum(c.frames_in for c in conns)
@@ -303,6 +397,7 @@ def _worker(worker_id: int, addr: str, n_conns: int, rate: float,
         "errors": errors,
         "send_errors": send_errors,
         "blocked": sum(c.blocked for c in conns),
+        "redirects_followed": sum(c.redirects for c in conns),
         "elapsed": elapsed,
     })
 
@@ -404,6 +499,11 @@ def main() -> None:
     p.add_argument("--server-addr", default="127.0.0.1:11288",
                    help="gateway SERVER listener; forward mode spawns a "
                         "GLOBAL-owner drain connection there")
+    p.add_argument("--follow-redirects", action="store_true",
+                   help="decode inbound frames and follow "
+                        "ClientRedirectMessages to the gateway now "
+                        "hosting the connection's interest (federation "
+                        "soaks/benches; costs per-frame protobuf parses)")
     args = p.parse_args()
 
     import threading
@@ -428,6 +528,7 @@ def main() -> None:
         proc = mp.Process(target=worker, args=(
             w, args.addr, n, args.rate, args.duration,
             args.connect_stagger_ms / 1000.0, args.mode, queue,
+            args.follow_redirects,
         ))
         proc.start()
         workers.append(proc)
@@ -483,6 +584,8 @@ def main() -> None:
         "connect_errors": sum(r["errors"] for r in results),
         "send_errors_dead_socket": sum(r["send_errors"] for r in results),
         "sends_blocked_backpressure": sum(r.get("blocked", 0) for r in results),
+        "redirects_followed": sum(
+            r.get("redirects_followed", 0) for r in results),
         "gateway_metrics_delta": {k: round(v) for k, v in sorted(gw_delta.items())},
         "gateway_connection_num": {
             k: v for k, v in metrics_after.items() if "connection_num" in k

@@ -166,6 +166,7 @@ async def run_overload_soak(p: OverloadSoakParams) -> dict:
     from channeld_tpu.core.connection import init_connections
     from channeld_tpu.core.ddos import init_anti_ddos, unauth_reaper_loop
     from channeld_tpu.core.overload import governor, reset_overload
+    from channeld_tpu.federation import reset_federation
     from channeld_tpu.core.server import flush_loop, start_listening
     from channeld_tpu.core.settings import (
         ChannelSettings,
@@ -200,6 +201,11 @@ async def run_overload_soak(p: OverloadSoakParams) -> dict:
     # at L2+ anyway, but pinning it off keeps the saturation timeline
     # free of planned authority moves (scripts/balance_soak.py owns that).
     global_settings.balancer_enabled = False
+    # Federation stays pinned OFF: a remote shard would route some
+    # crossings over a trunk and break this soak's deterministic
+    # single-gateway accounting (doc/federation.md).
+    reset_federation()
+    global_settings.federation_config = ""
     global_settings.tpu_entity_capacity = p.entity_capacity
     global_settings.tpu_query_capacity = p.query_capacity
     global_settings.overload_down_hold_s = p.down_hold_s

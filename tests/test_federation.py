@@ -1,0 +1,494 @@
+"""Cross-gateway federation plane (channeld_tpu/federation): the shard
+directory, trunk reconnect backoff, the remote-journal exclusion, L3
+refusal semantics, client-redirect x connection-recovery interaction,
+and the <60s seeded 2-gateway smoke soak.
+
+The full acceptance soak (SOAK_FED_r10.json) runs the same machinery via
+``python scripts/federation_soak.py`` and as the ``slow``-marked test at
+the bottom; its artifact schema is pinned here too.
+"""
+
+import asyncio
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+from channeld_tpu.core import connection as connection_mod
+from channeld_tpu.core import connection_recovery as recovery_mod
+from channeld_tpu.core.channel import (
+    create_channel_with_id,
+    get_channel,
+    get_global_channel,
+)
+from channeld_tpu.core.connection import add_connection
+from channeld_tpu.core.connection_recovery import (
+    ConnectionRecoverHandle,
+    get_recover_handle,
+    stage_recovery_handle,
+)
+from channeld_tpu.core.failover import journal, reset_failover
+from channeld_tpu.core.overload import OverloadLevel, governor
+from channeld_tpu.core.settings import global_settings
+from channeld_tpu.core.types import (
+    ChannelType,
+    ConnectionType,
+    MessageType,
+)
+from channeld_tpu.federation import reset_federation
+from channeld_tpu.federation.directory import ShardDirectory
+from channeld_tpu.federation.trunk import backoff_schedule
+from channeld_tpu.models.sim import register_sim_types
+from channeld_tpu.protocol import (
+    FrameDecoder,
+    control_pb2,
+    encode_packet,
+    wire_pb2,
+)
+from channeld_tpu.spatial.grid import StaticGrid2DSpatialController
+
+from helpers import FakeTransport, fresh_runtime
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+START = 0x10000
+
+
+@pytest.fixture(autouse=True)
+def runtime():
+    gch = fresh_runtime()
+    global_settings.development = True
+    connection_mod.set_fsm_templates(None, None)
+    reset_federation()
+    yield gch
+    reset_federation()
+
+
+FED_CFG = {
+    "secret": "s3",
+    "gateways": {
+        "a": {"trunk": "127.0.0.1:1", "client": "127.0.0.1:2",
+               "servers": [0]},
+        "b": {"trunk": "127.0.0.1:3", "client": "127.0.0.1:4",
+               "servers": [1]},
+    },
+}
+
+
+def make_grid(cols=4, rows=4, server_cols=2, server_rows=1):
+    ctl = StaticGrid2DSpatialController()
+    ctl.load_config({
+        "GridWidth": 50, "GridHeight": 50, "GridCols": cols,
+        "GridRows": rows, "ServerCols": server_cols,
+        "ServerRows": server_rows,
+    })
+    return ctl
+
+
+# ---- shard directory -------------------------------------------------------
+
+
+def test_directory_maps_cells_through_server_blocks():
+    d = ShardDirectory()
+    d.load_dict(FED_CFG, "a")
+    ctl = make_grid()
+    d.attach_resolver(lambda cid: ctl.server_index_of_cell(cid))
+    # Server block 0 = columns 0-1, block 1 = columns 2-3 (every row).
+    for row in range(4):
+        assert d.gateway_of_cell(START + row * 4 + 0) == "a"
+        assert d.gateway_of_cell(START + row * 4 + 1) == "a"
+        assert d.gateway_of_cell(START + row * 4 + 2) == "b"
+        assert d.gateway_of_cell(START + row * 4 + 3) == "b"
+    assert d.is_local_cell(START) and not d.is_local_cell(START + 2)
+    assert d.local_server_indices() == [0]
+    assert d.peers() == ["b"]
+    assert d.trunk_addr("b") == "127.0.0.1:3"
+    assert d.client_addr("b") == "127.0.0.1:4"
+
+
+def test_directory_unmapped_cells_degrade_to_local():
+    d = ShardDirectory()
+    d.load_dict(FED_CFG, "a")
+    # No resolver attached: every cell counts as local (pre-federation
+    # behavior, never a handover aimed at nobody).
+    assert d.is_local_cell(START + 3)
+    ctl = make_grid()
+    d.attach_resolver(lambda cid: ctl.server_index_of_cell(cid))
+    # Outside the grid -> resolver raises -> treated local.
+    assert d.is_local_cell(START + 10_000)
+
+
+def test_directory_runtime_update_is_monotonic():
+    d = ShardDirectory()
+    d.load_dict(FED_CFG, "a")
+    ctl = make_grid()
+    d.attach_resolver(lambda cid: ctl.server_index_of_cell(cid))
+    assert d.gateway_of_cell(START + 2) == "b"
+    assert d.apply_update({START + 2: "a"}, 1)
+    assert d.gateway_of_cell(START + 2) == "a"  # override wins
+    assert not d.apply_update({START + 2: "b"}, 1)  # stale: ignored
+    assert d.gateway_of_cell(START + 2) == "a"
+    assert d.apply_update({START + 2: "b"}, 2)
+    assert d.gateway_of_cell(START + 2) == "b"
+
+
+def test_directory_rejects_conflicting_server_claims():
+    bad = {"gateways": {
+        "a": {"servers": [0, 1]},
+        "b": {"servers": [1]},
+    }}
+    with pytest.raises(ValueError):
+        ShardDirectory().load_dict(bad, "a")
+    with pytest.raises(ValueError):
+        ShardDirectory().load_dict(FED_CFG, "nope")
+
+
+def test_federated_grid_allocates_only_local_server_blocks():
+    from channeld_tpu.federation.directory import directory
+
+    directory.load_dict(FED_CFG, "b")
+    ctl = make_grid()
+    directory.attach_resolver(lambda cid: ctl.server_index_of_cell(cid))
+    ctl._init_server_connections()
+    # Gateway b owns server index 1 only: the first (and only) free
+    # slot this gateway may fill is 1; once taken, the world is "full"
+    # here even though slot 0 (gateway a's block) stays None.
+    assert ctl._next_server_index() == 1
+
+    class _Conn:
+        def is_closing(self):
+            return False
+
+    ctl.server_connections[1] = _Conn()
+    assert ctl._next_server_index() == 2  # == n_servers: local shard full
+
+
+# ---- trunk reconnect backoff ----------------------------------------------
+
+
+def test_backoff_schedule_is_deterministic_and_capped():
+    a = [backoff_schedule(i, 100, 5000, "b") for i in range(12)]
+    b = [backoff_schedule(i, 100, 5000, "b") for i in range(12)]
+    assert a == b  # deterministic per (peer, attempt)
+    # Exponential-ish up to the cap, +-20% jitter around base*2^n.
+    for i, delay in enumerate(a):
+        ideal = min(100 * (2 ** i), 5000) / 1000.0
+        assert 0.8 * ideal <= delay <= 1.2 * ideal
+    # Far attempts stay capped (never overflow).
+    assert backoff_schedule(10_000, 100, 5000, "b") <= 5000 * 1.2 / 1000.0
+
+
+def test_backoff_jitter_varies_by_peer():
+    assert backoff_schedule(3, 100, 5000, "b") != \
+        backoff_schedule(3, 100, 5000, "c")
+
+
+# ---- remote journal records vs local failover resolution -------------------
+
+
+def test_remote_journal_records_survive_local_resolution():
+    register_sim_types()
+    from channeld_tpu.models import sim_pb2
+
+    src = create_channel_with_id(START + 1, ChannelType.SPATIAL, None)
+    src.init_data(None, None)
+    d = sim_pb2.SimEntityChannelData()
+    d.state.entityId = 0x80001
+    # Remote txn: dst cell id has NO local channel, on purpose.
+    remote = journal.prepare({0x80001: d}, START + 1, START + 2,
+                             remote=True)
+    local = journal.prepare({0x80002: d}, START + 1, START + 99)
+    assert journal.in_flight_count() == 2
+
+    aborted = journal.resolve_in_flight()
+    # The local record's dst channel doesn't exist -> aborted; the
+    # remote record is the federation plane's to resolve -> untouched.
+    assert [r.entity_id for r in aborted] == [0x80002]
+    assert journal.pending_dst(0x80001) == START + 2
+    assert remote[0].state == "prepared"
+    # The federation plane later commits it over the trunk ack.
+    journal.commit(remote)
+    assert journal.in_flight_count() == 0
+    assert local[0].state == "aborted"
+
+
+# ---- L3 refusal over the trunk ---------------------------------------------
+
+
+def test_admit_federation_refuses_only_at_l3():
+    global_settings.overload_retry_after_ms = 777
+    governor._move(2)
+    assert governor.admit_federation_handover().admitted
+    governor._move(3)
+    decision = governor.admit_federation_handover()
+    assert not decision.admitted
+    assert decision.retry_after_ms == 777
+    assert decision.reason == "federation"
+
+
+def test_prepare_refused_at_l3_with_busy_frame():
+    """An inbound TrunkHandoverPrepare at L3 is refused with the same
+    ServerBusyMessage a refused client would get, and counted in both
+    the governor shed ledger and the federation ledger."""
+    from channeld_tpu.federation.plane import plane
+
+    register_sim_types()
+
+    sent = []
+
+    class _Link:
+        alive = True
+        peer_id = "a"
+
+        def send(self, msg_type, msg):
+            sent.append((msg_type, msg))
+            return True
+
+    class _Mgr:
+        links = {"a": _Link()}
+
+        def stop(self):
+            pass
+
+    plane.manager = _Mgr()
+    governor._move(3)
+    before = governor.shed_counts.get("federation_handover", 0)
+    msg = control_pb2.TrunkHandoverPrepareMessage(
+        batchId=7, srcChannelId=START + 2, dstChannelId=START + 1)
+    e = msg.entities.add()
+    e.entityId = 0x80001
+    plane._handle_prepare("a", msg)
+
+    assert governor.shed_counts["federation_handover"] == before + 1
+    assert plane.ledger.get("refused_remote") == 1
+    (ack_type, ack), = sent
+    assert ack_type == MessageType.TRUNK_HANDOVER_ACK
+    assert not ack.committed and ack.HasField("busy")
+    assert ack.busy.reason == "federation"
+    assert ack.busy.overloadLevel == 3
+    assert ack.busy.retryAfterMs == global_settings.overload_retry_after_ms
+
+
+# ---- client redirect x connection recovery ---------------------------------
+
+
+def wire(msg_type: int, msg, channel_id: int = 0) -> bytes:
+    return encode_packet(wire_pb2.Packet(messages=[wire_pb2.MessagePack(
+        channelId=channel_id, msgType=msg_type,
+        msgBody=msg.SerializeToString(),
+    )]))
+
+
+def sent_messages(transport: FakeTransport) -> list:
+    dec = FrameDecoder()
+    out = []
+    for chunk in transport.written:
+        for packet in dec.decode_packets(chunk):
+            out.extend(packet.messages)
+    return out
+
+
+def test_stage_recovery_handle_reserves_id_and_stashes_subs():
+    register_sim_types()
+    ch = create_channel_with_id(START + 1, ChannelType.SPATIAL, None)
+    ch.init_data(None, None)
+    handle = stage_recovery_handle("fed-client-9", [ch.id, START + 999])
+    assert handle.staged
+    assert get_recover_handle("fed-client-9") is handle
+    assert handle.prev_conn_id in connection_mod._reserved_conn_ids
+    assert "fed-client-9" in ch.recoverable_subs  # missing channel skipped
+
+    # The reserved id is never handed to a fresh connection.
+    t = FakeTransport()
+    conn = add_connection(t, ConnectionType.CLIENT)
+    assert conn.id != handle.prev_conn_id
+
+    # The redirected client arrives: auth with the staged PIT resumes
+    # through the ordinary recovery machinery — reclaimed id,
+    # shouldRecover, recovery data for the staged channel, RECOVERY_END.
+    t2 = FakeTransport()
+    conn2 = add_connection(t2, ConnectionType.CLIENT)
+    conn2.on_bytes(wire(MessageType.AUTH, control_pb2.AuthMessage(
+        playerIdentifierToken="fed-client-9")))
+    get_global_channel().tick_once(0)
+    assert conn2.id == handle.prev_conn_id
+    assert handle.prev_conn_id not in connection_mod._reserved_conn_ids
+    conn2.flush()
+    auth_results = [m for m in sent_messages(t2)
+                    if m.msgType == MessageType.AUTH]
+    ar = control_pb2.AuthResultMessage()
+    ar.ParseFromString(auth_results[0].msgBody)
+    assert ar.result == 0 and ar.shouldRecover
+    ch.tick_once(0)
+    conn2.flush()
+    recovered = [m for m in sent_messages(t2)
+                 if m.msgType == MessageType.RECOVERY_CHANNEL_DATA]
+    assert len(recovered) == 1
+    rm = control_pb2.ChannelDataRecoveryMessage()
+    rm.ParseFromString(recovered[0].msgBody)
+    assert rm.channelId == ch.id
+    assert conn2 in ch.subscribed_connections
+
+
+def test_restage_while_handle_outstanding_merges():
+    """A second redirect racing the first (or a redirect while the
+    client already holds a live recovery handle here) must reuse the
+    outstanding handle — same reclaimable conn id, stashes merged."""
+    register_sim_types()
+    ch1 = create_channel_with_id(START + 1, ChannelType.SPATIAL, None)
+    ch1.init_data(None, None)
+    ch2 = create_channel_with_id(START + 2, ChannelType.SPATIAL, None)
+    ch2.init_data(None, None)
+    h1 = stage_recovery_handle("pit-x", [ch1.id])
+    h2 = stage_recovery_handle("pit-x", [ch2.id])
+    assert h2 is h1
+    assert "pit-x" in ch1.recoverable_subs
+    assert "pit-x" in ch2.recoverable_subs
+    assert len([p for p in connection_mod._reserved_conn_ids]) == 1
+
+    # Also: staging over a REAL outstanding disconnect handle reuses it
+    # (the client reclaims the id it always had).
+    real = ConnectionRecoverHandle(prev_conn_id=4242, disconn_time=0.0)
+    recovery_mod._recover_handles["pit-y"] = real
+    h3 = stage_recovery_handle("pit-y", [ch1.id])
+    assert h3 is real and not h3.staged
+
+
+def test_staged_handle_expires_quietly():
+    """An unclaimed staged handle must release its reserved id and purge
+    its stashes WITHOUT a ServerLostEvent (no server died)."""
+    from channeld_tpu.core import events
+
+    register_sim_types()
+    ch = create_channel_with_id(START + 1, ChannelType.SPATIAL, None)
+    ch.init_data(None, None)
+    handle = stage_recovery_handle("ghost-pit", [ch.id])
+    lost = []
+    events.server_lost.listen_for(ch, lambda d: lost.append(d))
+    handle.disconn_time = -1e9  # way past the staged TTL
+    recovery_mod.tick_connection_recovery_once()
+    assert get_recover_handle("ghost-pit") is None
+    assert handle.prev_conn_id not in connection_mod._reserved_conn_ids
+    assert "ghost-pit" not in ch.recoverable_subs
+    assert lost == []
+    events.server_lost.unlisten_for(ch)
+
+
+def test_redirect_during_destination_l3_is_admitted():
+    """A redirected client arriving while the destination sits at L3
+    must be admitted: its staged recovery handle marks it as an
+    already-admitted session (the same exemption live recoveries get)."""
+    register_sim_types()
+    ch = create_channel_with_id(START + 1, ChannelType.SPATIAL, None)
+    ch.init_data(None, None)
+    stage_recovery_handle("vip-pit", [ch.id])
+    governor._move(3)
+
+    t = FakeTransport()
+    conn = add_connection(t, ConnectionType.CLIENT)
+    conn.on_bytes(wire(MessageType.AUTH, control_pb2.AuthMessage(
+        playerIdentifierToken="vip-pit")))
+    get_global_channel().tick_once(0)
+    assert not conn.is_closing()
+    busy = [m for m in sent_messages(t)
+            if m.msgType == MessageType.SERVER_BUSY]
+    assert busy == []
+
+    # An unstaged client at the same moment is refused.
+    t2 = FakeTransport()
+    conn2 = add_connection(t2, ConnectionType.CLIENT)
+    conn2.on_bytes(wire(MessageType.AUTH, control_pb2.AuthMessage(
+        playerIdentifierToken="pleb-pit")))
+    get_global_channel().tick_once(0)
+    assert conn2.is_closing()
+    assert [m for m in sent_messages(t2)
+            if m.msgType == MessageType.SERVER_BUSY]
+
+
+# ---- the 2-gateway soaks ---------------------------------------------------
+
+
+def _load_fed_soak():
+    spec = importlib.util.spec_from_file_location(
+        "federation_soak", os.path.join(REPO, "scripts",
+                                        "federation_soak.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("federation_soak", mod)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_federation_smoke_soak():
+    """Seeded <60s live smoke: two real gateways (one in-process, one
+    child process) share the world; a burst commits across the shard
+    boundary, the trunk is severed mid-burst and aborts
+    deterministically, the anchored client follows its redirect, and
+    the cross-federation census balances to zero lost / duplicated."""
+    mod = _load_fed_soak()
+    p = mod.FedSoakParams(
+        entities=32, burst=8, refusal_burst=4, sever_burst=8, herd_back=6,
+        phase_timeout_s=15.0, quiesce_s=1.5,
+    )
+    report = asyncio.run(mod.run_fed_soak(p))
+    failed = [c for c in report["invariants"]["checks"] if not c["ok"]]
+    assert report["invariants"]["ok"], failed
+    assert report["stats"]["committed"] >= 8
+    assert report["stats"]["refused"] >= 1
+    assert report["stats"]["redirects"] == 1
+    assert report["census"]["missing"] == []
+    assert report["census"]["duplicated"] == {}
+
+
+@pytest.mark.slow
+def test_federation_full_soak():
+    """The acceptance soak (SOAK_FED_r10.json form)."""
+    mod = _load_fed_soak()
+    p = mod.FedSoakParams(entities=96, burst=24, refusal_burst=10,
+                          sever_burst=24, herd_back=16)
+    report = asyncio.run(mod.run_fed_soak(p))
+    failed = [c for c in report["invariants"]["checks"] if not c["ok"]]
+    assert report["invariants"]["ok"], failed
+
+
+# ---- artifact schema pin ---------------------------------------------------
+
+
+def test_soak_fed_artifact_schema():
+    """SOAK_FED_r10.json stays parseable with the invariants that prove
+    the acceptance bar: a committed cross-gateway burst, deterministic
+    abort on the mid-burst sever, exact census, refusals == busy
+    frames, a seamless redirect, and exact double-entry accounting."""
+    path = os.path.join(REPO, "SOAK_FED_r10.json")
+    with open(path) as f:
+        report = json.load(f)
+    assert report["kind"] == "federation_soak"
+    for key in ("directory", "timeline", "redirect", "gateway_a",
+                "gateway_b", "census", "invariants", "stats"):
+        assert key in report, key
+    assert report["invariants"]["ok"] is True
+    names = {c["name"] for c in report["invariants"]["checks"]}
+    for required in (
+        "cross_gateway_handovers_committed",
+        "trunk_severed_mid_burst",
+        "sever_aborted_back_to_source",
+        "every_entity_on_exactly_one_gateway",
+        "refusals_equal_busy_frames",
+        "redirect_resumed_without_reauth",
+        "a_ledger_matches_metric",
+        "b_ledger_matches_metric",
+        "a_commits_equal_b_applies_minus_reconciled",
+        "journal_prepared_equals_committed_plus_aborted",
+    ):
+        assert required in names, required
+    stats = report["stats"]
+    assert stats["committed"] > 0
+    assert stats["aborted"] > 0
+    assert stats["redirects"] >= 1
+    assert report["census"]["missing"] == []
+    assert report["census"]["duplicated"] == {}
+    a = report["gateway_a"]
+    assert a["ledger"].get("committed") == a["metric_delta"].get("committed")
+    assert a["trunk"]["trunk_msgs_out"] > 0
+    assert a["trunk"]["redirects_total"] == stats["redirects"]
