@@ -33,6 +33,7 @@ from .data import (
 )
 from .overload import governor as _governor
 from .settings import global_settings
+from .tracing import recorder as _trace
 from .types import BroadcastType, ChannelType, ConnectionType, GLOBAL_CHANNEL_ID, MessageType
 
 logger = get_logger("channel")
@@ -450,6 +451,12 @@ class Channel:
                 controller.tick()
 
         self.tick_frames += 1
+        if self.channel_type == ChannelType.GLOBAL:
+            # The GLOBAL tick is the recorder's clock: every span this
+            # tick (any channel, any stage) is stamped with this number,
+            # which is what lets a dump say "tick 8041 spent 9.3ms in
+            # fan-out" instead of showing an anonymous timeline.
+            _trace.set_tick(self.tick_frames)
         # Deferred ingest runs land in the queue before it drains, so a
         # tick never misses traffic the per-read dispatch would have
         # delivered (also what keeps on_bytes + tick_once tests exact).
@@ -457,13 +464,18 @@ class Channel:
         if _connection_mod is None:
             from . import connection as _connection_mod
         _connection_mod.flush_pending_ingest()
+        msg_start = time.monotonic_ns()
+        had_msgs = bool(self.in_msg_queue)
         self._tick_messages(tick_start)
+        if had_msgs:
+            _trace.stage("messages", msg_start, lane=self.id)
         fanout_start = time.monotonic()
         tick_data(self, now)
         if self.subscribed_connections:
             metrics.fanout_decision_latency.labels(backend="host").observe(
                 time.monotonic() - fanout_start
             )
+            _trace.stage("fanout", int(fanout_start * 1e9), lane=self.id)
         self._tick_connections()
         self._tick_recoverable_subscriptions()
         # Per-tick budget accounting: observed here (not in the async
@@ -482,7 +494,28 @@ class Channel:
             if owner is not None:
                 _governor.note_server_cost(owner.id, elapsed)
         if self.channel_type == ChannelType.GLOBAL:
+            gov_start = time.monotonic_ns()
             _governor.update(self.tick_interval)
+            _trace.stage("overload", gov_start, lane=self.id)
+        if _trace.enabled:
+            # The tick span closes HERE (after the governor update) so
+            # the overload stage nests inside it — containment is how
+            # dumps reconstruct nesting; `elapsed` keeps its historical
+            # pre-governor window for the histogram/governor intake.
+            total = time.monotonic() - tick_start
+            _trace.span(
+                f"tick.{self.channel_type.name}",
+                int(tick_start * 1e9), lane=self.id,
+            )
+            if self.tick_interval > 0 and total > self.tick_interval:
+                # A blown tick budget freezes the ring: the dump holds
+                # the very stages that ate it (cooldown-bounded).
+                _trace.note_anomaly(
+                    "tick_budget",
+                    f"{self.channel_type.name} {self.id}: "
+                    f"{total * 1e3:.2f}ms > "
+                    f"{self.tick_interval * 1e3:.0f}ms",
+                )
 
     def _tick_messages(self, tick_start: float) -> None:
         """Drain the queue within the tick budget (ref: channel.go:389-412).

@@ -29,6 +29,7 @@ from ..utils.idalloc import hash_string
 from ..utils.logger import get_logger
 from . import events, metrics
 from .fsm import MessageFsm
+from .tracing import recorder as _trace
 from .settings import global_settings
 from .types import (
     CompressionType,
@@ -906,6 +907,7 @@ def flush_pending_ingest() -> None:
         # backlog must not eat the tick budget re-failing), but conns
         # blocked on a DIFFERENT, drained channel still flush now
         # (advisor r5 low: the old break delayed them a full cycle).
+        stash_start = _trace.now()
         full_channels: set[int] = set()
         for conn in list(_stash_retry):
             if conn.is_closing():
@@ -920,14 +922,20 @@ def flush_pending_ingest() -> None:
                 blocked = conn.pending_head_channel()
                 if blocked is not None:
                     full_channels.add(blocked)
+        _trace.stage("stash_retry", stash_start)
     if not _pending_ingest:
         return
     pending, _pending_ingest = _pending_ingest, set()
+    ingest_start = _trace.now()
     for conn in pending:
         if not conn.is_closing():
             conn.flush_ingest()
             if conn.has_pending():
                 _stash_retry[conn] = None
+    # One stage span per drain cycle, never per read: the per-read cost
+    # is what ROADMAP item 2 is about, and the whole point of the
+    # deferred run is that N reads share this ONE dispatch.
+    _trace.stage("ingest", ingest_start)
 
 
 def flush_all() -> None:

@@ -482,6 +482,18 @@ class FailoverPlane:
 
         elapsed_ms = (time.monotonic() - t0) * 1000.0
         metrics.failover_rehost_ms.observe(elapsed_ms)
+        from .tracing import recorder as _trace
+
+        if _trace.enabled:
+            # A failover epoch is a flight-recorder anomaly: the frozen
+            # timeline holds the ticks around the loss plus this whole
+            # re-host pass (its span lands just below).
+            _trace.span("failover.rehost", int(t0 * 1e9))
+            _trace.note_anomaly(
+                "failover_epoch",
+                f"{data.pit}: {len(assignments)}/{len(orphan_cells)} "
+                f"cells re-hosted in {elapsed_ms:.1f}ms",
+            )
         deadline_ms = st.failover_rehost_deadline_s * 1000.0
         log = logger.warning if elapsed_ms > deadline_ms else logger.info
         log(

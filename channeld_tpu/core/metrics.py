@@ -274,6 +274,46 @@ follower_interest_ms = Histogram(
     registry=registry,
 )
 
+# Flight recorder / tick-timeline tracing (core/tracing.py;
+# doc/observability.md).
+tick_stage_ms = Histogram(
+    "tick_stage_ms",
+    "Host cost of one named per-tick stage, milliseconds (ingest: "
+    "deferred-read drain; stash_retry: backpressure re-dispatch; "
+    "messages: channel queue drain incl. FSM dispatch; fanout: "
+    "ChannelData fan-out encode/send; device_step: batched engine "
+    "dispatch+step; readback: device->host interest-mask transfers; "
+    "follow_interests: the full follower pass; handover: crossing "
+    "orchestration; overload: governor update; trunk: trunk ingress "
+    "dispatch). The flight recorder observes these whether or not span "
+    "recording is enabled",
+    ["stage"],
+    buckets=(0.01, 0.05, 0.1, 0.5, 1.0, 2.0, 5.0, 10.0, 33.0, 100.0),
+    registry=registry,
+)
+trace_dumps = Counter(
+    "trace_dumps",
+    "Anomaly-triggered flight-recorder freezes by trigger (tick_budget: "
+    "a tick overran its interval; overload_transition: the degradation "
+    "ladder moved; handover_abort: a cross-gateway batch aborted; "
+    "migration_abort: a balancer cell migration rolled back; "
+    "failover_epoch: a dead server's cells were re-hosted; "
+    "manual/sigusr2/shutdown: explicit dump_trace calls). Anomaly "
+    "triggers count even when the dump itself was suppressed by the "
+    "cooldown; a disabled recorder (-trace false) counts nothing",
+    ["trigger"],
+    registry=registry,
+)
+follower_readbacks = Counter(
+    "follower_readbacks",
+    "Device->host interested_cells readbacks performed by "
+    "_apply_follow_interests — today one per AOI-following connection "
+    "per pass (ROADMAP item 1's measured bottleneck, ~330us each); the "
+    "batched-readback optimization must collapse this toward O(1) per "
+    "tick",
+    registry=registry,
+)
+
 # The goroutine-count analog: live asyncio tasks (one per channel tick,
 # listener, pump). Updated by the server's heartbeat (serve loops) and by
 # any caller of sample_runtime().

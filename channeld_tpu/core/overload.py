@@ -256,6 +256,17 @@ class OverloadGovernor:
             old, new_level, self.pressure, ", forced" if forced else "",
         )
         self._publish_level()
+        from .tracing import recorder as _trace
+
+        if _trace.enabled:
+            # A ladder move means the gateway changed service level —
+            # freeze the timeline that drove it (cooldown-bounded; the
+            # dump's last ticks show WHICH stage pushed the pressure).
+            _trace.note_anomaly(
+                "overload_transition",
+                f"L{int(old)}->L{int(new_level)} "
+                f"pressure={self.pressure:.3f}",
+            )
 
     def _publish_level(self) -> None:
         try:  # metrics import is lazy so this module stays cycle-free

@@ -66,6 +66,33 @@ def dump_tasks(out=None) -> str:
     return text
 
 
+def install_task_dump_signal(profile_path: str = "profiles") -> bool:
+    """Bind SIGUSR1 to a live task/thread dump so a stuck gateway can be
+    diagnosed WITHOUT ``-profile tasks`` having been pre-armed:
+    ``kill -USR1 <pid>`` writes the dump under the profile path and logs
+    where. Installed at server start (run_server); False where SIGUSR1
+    does not exist (non-POSIX) or outside the main thread."""
+
+    def _on_sigusr1(signum, frame) -> None:
+        os.makedirs(profile_path, exist_ok=True)
+        path = os.path.join(
+            profile_path,
+            f"tasks_sigusr1_{time.strftime('%Y%m%d%H%M%S')}.txt",
+        )
+        with open(path, "w") as f:
+            dump_tasks(f)
+        logger.warning("SIGUSR1: live task/thread dump written to %s", path)
+
+    sig = getattr(signal, "SIGUSR1", None)
+    if sig is None:
+        return False
+    try:
+        signal.signal(sig, _on_sigusr1)
+    except ValueError:
+        return False  # not the main thread
+    return True
+
+
 def start_profiling(kind: str, profile_path: str = "profiles") -> None:
     """(ref: StartProfiling). kind in {"", "cpu", "mem", "tpu", "tasks"}."""
     global _cpu_profiler, _mem_tracing, _tpu_trace_dir, _tasks_mode, \

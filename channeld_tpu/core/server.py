@@ -469,6 +469,26 @@ async def run_server(argv: Optional[list[str]] = None) -> None:
         from .profiling import start_profiling
 
         start_profiling(global_settings.profile, global_settings.profile_path)
+    # Flight recorder (doc/observability.md): configure from the -trace*
+    # flags, then wire the diagnostic signals — SIGUSR1 dumps live
+    # tasks/threads (no -profile tasks pre-arming needed), SIGUSR2 dumps
+    # the recorder ring as Perfetto JSON — and the shutdown dump.
+    from . import tracing
+    from .profiling import install_task_dump_signal
+
+    tracing.configure_from_settings()
+    install_task_dump_signal(global_settings.profile_path)
+    tracing.install_trace_dump_signal()
+    if global_settings.trace_enabled:
+        tracing.register_shutdown_dump()
+        logger.info(
+            "flight recorder armed: %d spans/thread, anomaly dumps keep "
+            "the last %d ticks under %s/ (SIGUSR2 = manual dump, "
+            "SIGUSR1 = task dump; doc/observability.md)",
+            global_settings.trace_ring_spans,
+            global_settings.trace_dump_ticks,
+            global_settings.profile_path,
+        )
     if global_settings.chaos_config:
         from ..chaos import arm_from_file
 

@@ -231,6 +231,17 @@ class GlobalSettings:
     federation_reconnect_base_ms: int = 100
     federation_reconnect_max_ms: int = 5000
 
+    # Flight recorder (new — doc/observability.md). Always-on by
+    # default: the recorder is fixed-memory (per-thread span rings) and
+    # its hot-path cost is two clock reads + a ring store per tick
+    # stage (<3% of the tick hot path, measured in TRACE_r11.json).
+    # Disabling it only stops span recording and anomaly auto-dumps;
+    # the tick_stage_ms histograms keep moving either way.
+    trace_enabled: bool = True
+    trace_ring_spans: int = 8192  # spans kept per writer thread
+    trace_dump_ticks: int = 200  # ticks frozen into an anomaly dump
+    trace_anomaly_cooldown_s: float = 5.0
+
     # Device mesh for the spatial engine: 0 devices = single-device step;
     # N>0 shards the entity arrays over the first N jax devices, and
     # hosts>1 arranges them as a (hosts, chips) DCN x ICI mesh — the TPU
@@ -381,6 +392,20 @@ class GlobalSettings:
                             "disables the federation plane")
         p.add_argument("-fed-id", type=str, default="",
                        help="this gateway's id in the federation config")
+        p.add_argument("-trace",
+                       type=lambda s: s.lower() not in
+                       ("false", "0", "no", "off"),
+                       default=self.trace_enabled,
+                       help="flight-recorder span recording + anomaly "
+                            "auto-dumps (doc/observability.md); false "
+                            "keeps only the tick_stage_ms histograms")
+        p.add_argument("-trace-ring", type=int,
+                       default=self.trace_ring_spans,
+                       help="spans kept per writer thread (fixed memory; "
+                            "overflow drops the oldest, counted exactly)")
+        p.add_argument("-trace-dump-ticks", type=int,
+                       default=self.trace_dump_ticks,
+                       help="GLOBAL ticks frozen into an anomaly dump")
         p.add_argument("-mesh-devices", type=int, default=self.tpu_mesh_devices,
                        help="shard the spatial engine over N devices "
                             "(0 = single-device step)")
@@ -435,6 +460,9 @@ class GlobalSettings:
         self.balancer_cooldown_ticks = args.balancer_cooldown
         self.federation_config = args.fed
         self.federation_gateway_id = args.fed_id
+        self.trace_enabled = args.trace
+        self.trace_ring_spans = args.trace_ring
+        self.trace_dump_ticks = args.trace_dump_ticks
         self.spatial_backend = args.spatial_backend
         self.tpu_mesh_devices = args.mesh_devices
         self.tpu_mesh_hosts = args.mesh_hosts

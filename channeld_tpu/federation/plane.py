@@ -51,6 +51,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from ..core.settings import global_settings
+from ..core.tracing import new_trace_id, recorder as _trace
 from ..core.types import (
     ChannelDataAccess,
     ChannelType,
@@ -88,6 +89,10 @@ class PendingBatch:
     entities: dict  # entity id -> data message (None for data-less)
     deadline: float
     redirect_conns: list = field(default_factory=list)
+    # Flight-recorder trace id: rides the trunk (TrunkHandoverPrepare/
+    # Ack traceId) so both gateways' recorders stamp this handover's
+    # spans with the same id (doc/observability.md).
+    trace_id: str = ""
 
 
 @dataclass
@@ -224,6 +229,8 @@ class FederationPlane:
             handover_entities, src_channel_id, dst_channel_id, remote=True
         )
         batch_id = records[0].txn_id
+        trace_id = new_trace_id(directory.local_id)
+        init_start = _trace.now()
 
         def _remove(ch):
             data_msg = ch.get_data_message()
@@ -244,6 +251,7 @@ class FederationPlane:
             batchId=batch_id,
             srcChannelId=src_channel_id,
             dstChannelId=dst_channel_id,
+            traceId=trace_id,
         )
         for rec in records:
             e = msg.entities.add()
@@ -257,12 +265,17 @@ class FederationPlane:
             records=records, entities=dict(handover_entities),
             deadline=time.monotonic()
             + global_settings.federation_handover_timeout_ms / 1000.0,
+            trace_id=trace_id,
         )
         self._pending[batch_id] = batch
         from ..core import metrics
 
         metrics.handover_count.inc(len(handover_entities))
-        if not link.send(MessageType.TRUNK_HANDOVER_PREPARE, msg):
+        sent = link.send(MessageType.TRUNK_HANDOVER_PREPARE, msg)
+        # Prepare-side work on the initiator (journal prepare, src
+        # remove, fan-out, trunk write), under the batch's trace id.
+        _trace.span("fed.prepare", init_start, trace=trace_id)
+        if not sent:
             # The link died under the write: deterministic abort, now.
             self._abort_batch(batch, "trunk lost at send")
 
@@ -356,6 +369,14 @@ class FederationPlane:
             "reason": reason, "entities": len(batch.records),
             "restored": restored,
         })
+        if _trace.enabled:
+            _trace.instant("fed.abort", trace=batch.trace_id or None)
+            # An abort is a cross-gateway anomaly by definition: freeze
+            # the timeline that led to it (cooldown-bounded).
+            _trace.note_anomaly(
+                "handover_abort",
+                f"batch {batch.batch_id} -> {batch.peer}: {reason}",
+            )
         logger.warning(
             "fed handover batch %d -> %s aborted (%s): %d entities "
             "restored to cell %d", batch.batch_id, batch.peer, reason,
@@ -367,6 +388,7 @@ class FederationPlane:
         from ..core.failover import journal
         from ..spatial.controller import get_spatial_controller
 
+        commit_start = _trace.now()
         flips = journal.commit(batch.records)
         ctl = get_spatial_controller()
         moved_hook = getattr(ctl, "_note_entity_data_moved", None)
@@ -394,6 +416,8 @@ class FederationPlane:
             "kind": "commit", "batch": batch.batch_id, "peer": batch.peer,
             "entities": len(batch.records), "redirect_conns": redirected,
         })
+        _trace.span("fed.commit", commit_start,
+                    trace=batch.trace_id or None)
 
     # ---- initiator: client redirect --------------------------------------
 
@@ -413,27 +437,32 @@ class FederationPlane:
         link = self.link_to(batch.peer)
         if link is None:
             self._send_redirect(conn, batch.peer, entity_id,
-                                batch.dst_channel_id, token, staged=False)
+                                batch.dst_channel_id, token, staged=False,
+                                trace=batch.trace_id)
             return
         self._pending_redirects[conn.pit] = (
             conn, entity_id, batch.dst_channel_id, batch.peer, token,
             time.monotonic()
             + global_settings.federation_handover_timeout_ms / 1000.0,
+            batch.trace_id,
         )
         link.send(
             MessageType.TRUNK_STAGE_REDIRECT,
             control_pb2.TrunkStageRedirectMessage(
                 pit=conn.pit, entityId=entity_id,
                 channelIds=[batch.dst_channel_id, entity_id], token=token,
+                traceId=batch.trace_id,
             ),
         )
 
     def _send_redirect(self, conn, peer: str, entity_id: int,
-                       dst_cid: int, token: str, staged: bool) -> None:
+                       dst_cid: int, token: str, staged: bool,
+                       trace: str = "") -> None:
         from ..core.message import MessageContext
 
         if conn.is_closing():
             return
+        _trace.instant("fed.redirect", trace=trace or None)
         addr = directory.client_addr(peer) or ""
         conn.send(MessageContext(
             msg_type=MessageType.CLIENT_REDIRECT,
@@ -464,9 +493,9 @@ class FederationPlane:
         pending = self._pending_redirects.pop(msg.pit, None)
         if pending is None:
             return
-        conn, entity_id, dst_cid, _peer, token, _deadline = pending
+        conn, entity_id, dst_cid, _peer, token, _deadline, trace = pending
         self._send_redirect(conn, peer, entity_id, dst_cid, token,
-                            staged=bool(msg.ok))
+                            staged=bool(msg.ok), trace=trace)
 
     # ---- receiver: adopt / refuse / reconcile ----------------------------
 
@@ -479,15 +508,23 @@ class FederationPlane:
         from ..spatial.controller import get_spatial_controller
 
         link = self.link_to(peer)
+        # The initiator's trace id, propagated over the trunk: every
+        # adoption span here carries it, so one id stitches the
+        # handover across both gateways' recorders.
+        trace = msg.traceId or None
+        apply_start = _trace.now()
 
         def _ack(committed: bool, busy=None, reason: str = "") -> None:
             ack = control_pb2.TrunkHandoverAckMessage(
                 batchId=msg.batchId, committed=committed, reason=reason,
+                traceId=msg.traceId,
             )
             if busy is not None:
                 ack.busy.CopyFrom(busy)
             if link is not None:
                 link.send(MessageType.TRUNK_HANDOVER_ACK, ack)
+            _trace.span("fed.apply" if committed else "fed.refuse",
+                        apply_start, trace=trace)
 
         decision = governor.admit_federation_handover()
         if not decision.admitted:
@@ -757,6 +794,7 @@ class FederationPlane:
     def _handle_stage_redirect(self, peer: str, msg) -> None:
         from ..core.connection_recovery import stage_recovery_handle
 
+        _trace.instant("fed.stage", trace=msg.traceId or None)
         link = self.link_to(peer)
         try:
             handle = stage_recovery_handle(msg.pit, list(msg.channelIds))
@@ -954,9 +992,9 @@ class FederationPlane:
                 if now <= pending[5]:
                     continue
                 del self._pending_redirects[pit]
-                conn, entity_id, dst_cid, peer, token, _d = pending
+                conn, entity_id, dst_cid, peer, token, _d, trace = pending
                 self._send_redirect(conn, peer, entity_id, dst_cid,
-                                    token, staged=False)
+                                    token, staged=False, trace=trace)
 
     # ---- reporting -------------------------------------------------------
 

@@ -33,6 +33,7 @@ from typing import Awaitable, Callable, Optional
 
 from ..chaos.injector import chaos as _chaos
 from ..core.settings import global_settings
+from ..core.tracing import recorder as _trace
 from ..core.types import MessageType
 from ..protocol import control_pb2, wire_pb2
 from ..protocol.framing import FrameDecoder, FramingError, encode_packet
@@ -181,16 +182,24 @@ class TrunkLink:
                 self._go_down("peer closed")
                 return
             self._last_rx = time.monotonic()
+            trunk_start = _trace.now()
             try:
                 packets = self._decoder.decode_packets(data)
             except FramingError as e:
                 logger.error("trunk %s framing error: %s", self.peer_id, e)
                 self._go_down("framing error")
                 return
+            dispatched = False
             for packet in packets:
                 for mp in packet.messages:
+                    dispatched = True
                     if not self._dispatch(mp):
                         return
+            if dispatched:
+                # Decode + dispatch for one trunk read — the federation
+                # plane's share of the tick timeline (heartbeat-only
+                # reads included: they ARE trunk I/O cost).
+                _trace.stage("trunk", trunk_start)
 
     def _on_heartbeat(self, msg) -> None:
         from ..core import metrics

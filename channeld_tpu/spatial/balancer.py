@@ -414,6 +414,13 @@ class BalancerPlane:
             ch is not None and ch.get_owner() is mig.src_conn
         )
         self.events.append(ev)
+        from ..core.tracing import recorder as _trace
+
+        if _trace.enabled:
+            _trace.note_anomaly(
+                "migration_abort",
+                f"migration {mig.migration_id} cell {mig.cell_id}: {reason}",
+            )
         logger.warning(
             "migration %d aborted (%s): cell %d stays with server %d",
             mig.migration_id, reason, mig.cell_id, mig.src_conn.id,

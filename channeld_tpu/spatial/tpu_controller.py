@@ -28,6 +28,7 @@ from ..core.failover import journal as _journal
 from ..core.overload import governor as _governor
 from .balancer import balancer as _balancer
 from ..core.settings import global_settings
+from ..core.tracing import recorder as _trace
 from ..utils.logger import get_logger
 from .controller import SpatialInfo, register_spatial_controller_type
 from .grid import StaticGrid2DSpatialController
@@ -411,9 +412,14 @@ class TPUSpatialController(StaticGrid2DSpatialController):
                 apply_interest_diff(entry["conn"], {})
 
     def _apply_follow_interests(self, result) -> None:
+        import time as _time
+
+        from ..core import metrics
         from ..spatial.messages import apply_interest_diff
 
         start = global_settings.spatial_channel_id_start
+        readbacks = 0
+        readback_ns = 0
         for conn_id, entry in list(self._followers.items()):
             conn = entry["conn"]
             if conn.is_closing():
@@ -429,10 +435,22 @@ class TPUSpatialController(StaticGrid2DSpatialController):
                     entry["extent"], entry["direction"], entry["angle"],
                 )
                 entry["center"] = (info.x, info.z)
+            rb0 = _time.monotonic_ns()
             desired = self.engine.interested_cells(result, conn_id)
+            readback_ns += _time.monotonic_ns() - rb0
+            readbacks += 1
             apply_interest_diff(
                 conn, {start + cell: dist for cell, dist in desired.items()}
             )
+        if readbacks:
+            # ROADMAP item 1's bottleneck made live-visible: one
+            # device->host transfer PER follower today; the batched
+            # readback must drive this toward one per tick. The stage
+            # is the pass's aggregated transfer time (a synthetic
+            # contiguous span so the timeline shows its tick share).
+            metrics.follower_readbacks.inc(readbacks)
+            rb_end = _time.monotonic_ns()
+            _trace.stage("readback", rb_end - readback_ns, end_ns=rb_end)
 
     def tick(self) -> None:
         super().tick()  # reap closed server connections
@@ -460,6 +478,9 @@ class TPUSpatialController(StaticGrid2DSpatialController):
         result = self.engine.tick()
         handovers = self.engine.handover_list(result)
         metrics.tpu_step_latency.observe(_time.monotonic() - t0)
+        # Same window as tpu_step_latency: dispatch + device step + the
+        # handover-list readback.
+        _trace.stage("device_step", int(t0 * 1e9))
         metrics.tpu_entities.set(self.engine.entity_count())
         if "overflow" in result:
             # Cells-plane bucket overflow: the undelivered entities stay
@@ -551,6 +572,7 @@ class TPUSpatialController(StaticGrid2DSpatialController):
             t_ho = _time.monotonic()
             StaticGrid2DSpatialController.notify_crossings(self, batch)
             _governor.note_handover_cost(_time.monotonic() - t_ho)
+            _trace.stage("handover", int(t_ho * 1e9))
         if self._followers:
             if _governor.level >= 2 and not self._follow_skip:
                 # L2+: follower interests re-center every OTHER tick —
@@ -564,6 +586,7 @@ class TPUSpatialController(StaticGrid2DSpatialController):
                 t_fi = _time.monotonic()
                 self._apply_follow_interests(result)
                 cost = _time.monotonic() - t_fi
+                _trace.stage("follow_interests", int(t_fi * 1e9))
                 # The previously-unmeasured host cost inside the GLOBAL
                 # tick budget (VERDICT weak #5): now a first-class
                 # histogram and a pressure-signal input.

@@ -183,6 +183,14 @@ async def run_balance_soak(p: BalanceSoakParams) -> dict:
     reset_balancer()
 
     global_settings.development = True
+    # Flight recorder pinned OFF (doc/observability.md): these soaks
+    # prove deterministic accounting and timing envelopes; span
+    # recording and anomaly auto-dumps must not perturb either
+    # (scripts/trace_soak.py is the recorder's own soak).
+    global_settings.trace_enabled = False
+    from channeld_tpu.core.tracing import recorder as _flight_recorder
+
+    _flight_recorder.configure(enabled=False)
     global_settings.tpu_entity_capacity = p.entity_capacity
     global_settings.tpu_query_capacity = p.query_capacity
     # This soak proves the BALANCER plane; the overload ladder stays

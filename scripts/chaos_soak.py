@@ -494,6 +494,14 @@ async def run_soak(p: SoakParams) -> dict:
     # This soak proves the CHAOS plane: the balancer's planned migrations
     # would add nondeterministic authority moves to a seeded scenario.
     global_settings.balancer_enabled = False
+    # Flight recorder pinned OFF (doc/observability.md): these soaks
+    # prove deterministic accounting and timing envelopes; span
+    # recording and anomaly auto-dumps must not perturb either
+    # (scripts/trace_soak.py is the recorder's own soak).
+    global_settings.trace_enabled = False
+    from channeld_tpu.core.tracing import recorder as _flight_recorder
+
+    _flight_recorder.configure(enabled=False)
     # Federation stays pinned OFF: a remote shard would route some
     # crossings over a trunk and break this soak's deterministic
     # single-gateway accounting (doc/federation.md).

@@ -1,0 +1,161 @@
+"""Artifact + doc drift checker (run from tier-1: tests/test_artifacts.py).
+
+Two classes of silent rot this repo has accumulated defenses against,
+now checked in one place on every test run:
+
+1. **Committed artifacts** — every ``SOAK_*.json`` / ``BENCH_*.json`` /
+   ``TRACE_*.json`` at the repo root must parse and match its schema
+   (the required keys its soak/bench writer emits and its README/docs
+   claims cite). A soak refactor that silently changes an artifact's
+   shape fails here instead of when a reviewer re-reads the claim.
+2. **Doc'd metric names** — every Prometheus metric a doc or the README
+   references must exist in ``core/metrics.py``. Renaming a metric
+   without fixing the docs (or documenting a metric that was never
+   registered) fails fast.
+
+Usage: ``python scripts/check_artifacts.py`` (exit 0 = clean).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+# ---------------------------------------------------------------------------
+# artifact schemas: filename glob -> required top-level keys (+ checks)
+# ---------------------------------------------------------------------------
+
+# Every soak artifact is written by an InvariantChecker-driven harness:
+# it must carry its kind tag and a PASSING invariants summary — a
+# committed artifact documenting a failed run is drift by definition.
+_SOAK_KEYS = {"kind", "invariants"}
+
+SCHEMAS: dict[str, set] = {
+    "SOAK_r*.json": _SOAK_KEYS | {"scenario", "stats", "duration_s"},
+    "SOAK_OVERLOAD_*.json": _SOAK_KEYS | {"governor", "phases", "max_level"},
+    "SOAK_FAILOVER_*.json": _SOAK_KEYS | {"failover", "journal", "kills"},
+    "SOAK_BALANCE_*.json": _SOAK_KEYS | {"balancer", "journal", "kill"},
+    "SOAK_FED_*.json": _SOAK_KEYS | {
+        "census", "gateway_a", "gateway_b", "redirect", "timeline",
+    },
+    # Bench artifacts predate the kind tag; pin the keys their
+    # BENCH_RESULTS.md / README claims actually cite.
+    "BENCH_r*.json": {"cmd", "rc", "parsed"},
+    "BENCH_GATEWAY_*.json": {"headline", "runs", "metric"},
+    "BENCH_HANDOVER_*.json": {"metric", "crossings_per_tick",
+                              "keeps_up_with_detection"},
+    "BENCH_FANOUT_*.json": {"metric", "configs", "p99_under_5ms_all"},
+    # Flight-recorder soak (doc/observability.md acceptance artifact).
+    "TRACE_*.json": _SOAK_KEYS | {
+        "stages", "anomaly_dumps", "cross_gateway", "overhead",
+    },
+}
+
+
+def check_artifacts(repo: str = REPO) -> list[str]:
+    errors: list[str] = []
+    matched: set[str] = set()
+    for pattern, required in SCHEMAS.items():
+        for path in sorted(glob.glob(os.path.join(repo, pattern))):
+            name = os.path.basename(path)
+            matched.add(name)
+            try:
+                doc = json.load(open(path))
+            except ValueError as e:
+                errors.append(f"{name}: unparseable JSON ({e})")
+                continue
+            if not isinstance(doc, dict):
+                errors.append(f"{name}: expected a JSON object")
+                continue
+            missing = required - set(doc)
+            if missing:
+                errors.append(f"{name}: missing keys {sorted(missing)}")
+            inv = doc.get("invariants")
+            if "invariants" in required and isinstance(inv, dict):
+                if not inv.get("ok", False):
+                    errors.append(
+                        f"{name}: committed with failing invariants"
+                    )
+    # Nothing at the root may LOOK like a pinned artifact yet escape
+    # every schema (a new SOAK_X_rNN.json must land with a schema row).
+    for path in sorted(
+        glob.glob(os.path.join(repo, "SOAK_*.json"))
+        + glob.glob(os.path.join(repo, "BENCH_*.json"))
+        + glob.glob(os.path.join(repo, "TRACE_*.json"))
+    ):
+        name = os.path.basename(path)
+        if name not in matched:
+            errors.append(f"{name}: no schema registered in "
+                          f"scripts/check_artifacts.py")
+    return errors
+
+
+# ---------------------------------------------------------------------------
+# doc'd metric names vs core/metrics.py
+# ---------------------------------------------------------------------------
+
+# Docs scanned for metric references. Counters appear as `name_total`
+# (the exposition-format name); labeled histograms/gauges as
+# `name{label}`. Bare `_ms`/`_seconds` tokens are NOT scanned — they
+# collide with settings knobs (`federation_heartbeat_ms` is a flag, not
+# a metric), and every labeled family the docs cite hits the braced
+# form anyway.
+DOC_GLOBS = ("doc/*.md", "README.md")
+
+_TOTAL_RE = re.compile(r"\b([a-z][a-z0-9_]*)_total\b")
+_BRACED_RE = re.compile(r"`([a-z][a-z0-9_]*)\{[a-zA-Z_,=\" ]*\}`")
+
+
+def registered_metric_names() -> set[str]:
+    from channeld_tpu.core.metrics import registry
+
+    names = set()
+    for family in registry.collect():
+        names.add(family.name)
+    return names
+
+
+def check_doc_metrics(repo: str = REPO) -> list[str]:
+    names = registered_metric_names()
+    errors: list[str] = []
+    for pattern in DOC_GLOBS:
+        for path in sorted(glob.glob(os.path.join(repo, pattern))):
+            text = open(path).read()
+            refs: set[str] = set(_TOTAL_RE.findall(text))
+            for base in _BRACED_RE.findall(text):
+                refs.add(base[:-6] if base.endswith("_total") else base)
+            for ref in sorted(refs):
+                if ref not in names:
+                    errors.append(
+                        f"{os.path.relpath(path, repo)}: references "
+                        f"metric {ref!r} not registered in "
+                        f"core/metrics.py"
+                    )
+    return errors
+
+
+def main() -> int:
+    errors = check_artifacts() + check_doc_metrics()
+    if errors:
+        for e in errors:
+            print(f"DRIFT: {e}")
+        return 1
+    n_artifacts = len(
+        glob.glob(os.path.join(REPO, "SOAK_*.json"))
+        + glob.glob(os.path.join(REPO, "BENCH_*.json"))
+        + glob.glob(os.path.join(REPO, "TRACE_*.json"))
+    )
+    print(f"clean: {n_artifacts} artifacts, "
+          f"{len(registered_metric_names())} metric families")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

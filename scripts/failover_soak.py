@@ -202,6 +202,14 @@ async def run_failover_soak(p: FailoverSoakParams) -> dict:
     # L3 admission control and refuse the soak's own client fleet (the
     # overload soak owns that interplay).
     global_settings.overload_enabled = False
+    # Flight recorder pinned OFF (doc/observability.md): these soaks
+    # prove deterministic accounting and timing envelopes; span
+    # recording and anomaly auto-dumps must not perturb either
+    # (scripts/trace_soak.py is the recorder's own soak).
+    global_settings.trace_enabled = False
+    from channeld_tpu.core.tracing import recorder as _flight_recorder
+
+    _flight_recorder.configure(enabled=False)
     # ... and the balancer stays off for the same reason: this soak's
     # re-host accounting must see only CRASH-path authority moves
     # (scripts/balance_soak.py proves the planned-migration path).

@@ -249,6 +249,14 @@ async def boot_gateway(gw_id: str, fed_cfg: dict, params: FedSoakParams,
     # nondeterministic authority moves (L3 is driven explicitly in the
     # refusal phase instead).
     global_settings.balancer_enabled = False
+    # Flight recorder pinned OFF (doc/observability.md): these soaks
+    # prove deterministic accounting and timing envelopes; span
+    # recording and anomaly auto-dumps must not perturb either
+    # (scripts/trace_soak.py is the recorder's own soak).
+    global_settings.trace_enabled = False
+    from channeld_tpu.core.tracing import recorder as _flight_recorder
+
+    _flight_recorder.configure(enabled=False)
     global_settings.overload_enabled = True
     global_settings.overload_enter_thresholds = (99.0, 99.0, 99.0)
     global_settings.overload_down_hold_s = 9999.0

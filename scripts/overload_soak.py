@@ -201,6 +201,14 @@ async def run_overload_soak(p: OverloadSoakParams) -> dict:
     # at L2+ anyway, but pinning it off keeps the saturation timeline
     # free of planned authority moves (scripts/balance_soak.py owns that).
     global_settings.balancer_enabled = False
+    # Flight recorder pinned OFF (doc/observability.md): these soaks
+    # prove deterministic accounting and timing envelopes; span
+    # recording and anomaly auto-dumps must not perturb either
+    # (scripts/trace_soak.py is the recorder's own soak).
+    global_settings.trace_enabled = False
+    from channeld_tpu.core.tracing import recorder as _flight_recorder
+
+    _flight_recorder.configure(enabled=False)
     # Federation stays pinned OFF: a remote shard would route some
     # crossings over a trunk and break this soak's deterministic
     # single-gateway accounting (doc/federation.md).

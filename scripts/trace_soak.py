@@ -1,0 +1,819 @@
+"""Trace soak: the flight recorder's acceptance proof (TRACE_r11.json).
+
+Three phases exercise the recorder (core/tracing.py,
+doc/observability.md) the way it runs in production:
+
+1. **live** — a REAL single gateway (TCP listeners, 1ms pump, client
+   fleet streaming forwards, master + 4 spatial servers, the TPU
+   spatial controller on the cells plane, AOI followers) under a seeded
+   chaos scenario whose tick-budget and device-dispatch stalls blow the
+   GLOBAL tick on schedule. Produces the per-stage tick budgets
+   (``tick_stage_ms{stage}``: ingest, messages, fanout, device_step,
+   readback, follow_interests, handover, overload) and at least one
+   anomaly-triggered auto-dump (``trace_dumps_total{tick_budget}``),
+   validated against the Perfetto trace_event schema.
+2. **federation** — two gateway processes (reusing the federation
+   soak's boot) with tracing re-enabled: a committed cross-gateway
+   handover burst proves the trunk-propagated trace id stitches spans
+   from BOTH recorders into one trace; a mid-burst trunk sever proves
+   the handover_abort anomaly dump fires. Also covers the ``trunk``
+   stage.
+3. **overhead** — the same synchronous GLOBAL-tick hot path (device
+   step + entity updates) timed with the recorder enabled vs disabled,
+   interleaved rounds, medians: the acceptance bar is < 3% overhead,
+   plus the raw per-span cost in nanoseconds.
+
+Run the acceptance soak (~60s of timeline):
+  python scripts/trace_soak.py --out TRACE_r11.json
+
+The <60s CI smoke runs phases 1 and 3 with smaller numbers
+(tests/test_tracing.py::test_trace_soak_smoke).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+# chaos_soak pins the CPU platform + virtual devices BEFORE jax loads;
+# federation_soak only needs JAX_PLATFORMS=cpu.
+import chaos_soak as live  # noqa: E402
+import federation_soak as fed  # noqa: E402
+
+import argparse  # noqa: E402
+import asyncio  # noqa: E402
+import json  # noqa: E402
+import statistics  # noqa: E402
+import subprocess  # noqa: E402
+import time  # noqa: E402
+from dataclasses import dataclass, field  # noqa: E402
+from random import Random  # noqa: E402
+
+TRACE_STAGES = (
+    "ingest", "messages", "fanout", "device_step", "readback",
+    "follow_interests", "handover", "overload",
+)
+
+DEFAULT_SCENARIO = {
+    "name": "trace-soak",
+    "seed": 20260803,
+    "faults": [
+        # 60ms stall in a message handler: blows the 33ms GLOBAL budget
+        # -> the tick_budget anomaly freezes the ring.
+        {"point": "channel.tick_budget", "every_n": 300,
+         "stall_ms": 60, "max_fires": 6},
+        # Slow device dispatch: shows up in device_step's tail.
+        {"point": "device.dispatch_stall", "every_n": 200,
+         "stall_ms": 40, "max_fires": 8},
+    ],
+}
+
+
+@dataclass
+class TraceSoakParams:
+    live_s: float = 20.0
+    clients: int = 16
+    msg_rate: float = 30.0
+    entities: int = 120
+    followers: int = 8
+    storm_size: int = 40
+    quiesce_s: float = 3.0
+    fed_burst: int = 10
+    fed_sever_burst: int = 10
+    overhead_ticks: int = 120
+    overhead_rounds: int = 3
+    seed: int = 20260803
+    scenario: dict = field(default_factory=lambda: dict(DEFAULT_SCENARIO))
+    skip_federation: bool = False
+    out_path: str = ""
+
+
+def _recorder():
+    from channeld_tpu.core.tracing import recorder
+
+    return recorder
+
+
+def _check_perfetto(path: str) -> tuple[bool, str]:
+    """The same pinned schema tests/test_tracing.py enforces. Anomaly
+    dumps are written off-thread, so wait (bounded) for the file to
+    land and parse before judging it."""
+    doc = None
+    deadline = time.monotonic() + 3.0
+    while doc is None:
+        try:
+            doc = json.load(open(path))
+        except (OSError, ValueError) as e:
+            if time.monotonic() > deadline:
+                return False, f"unreadable: {e}"
+            time.sleep(0.05)
+    try:
+        assert set(doc) >= {"traceEvents", "displayTimeUnit", "otherData"}
+        for ev in doc["traceEvents"]:
+            assert set(ev) >= {"name", "ph", "ts", "pid", "tid", "args"}
+            assert ev["ph"] in ("X", "i")
+            assert "tick" in ev["args"]
+            if ev["ph"] == "X":
+                assert ev["dur"] >= 0
+    except AssertionError as e:
+        return False, f"schema violation: {e}"
+    return True, f"{len(doc['traceEvents'])} events"
+
+
+def _stage_stats(d: dict) -> dict:
+    from channeld_tpu.chaos.invariants import histogram_quantile
+
+    stages: dict[str, dict] = {}
+    for (name, labels), value in d.items():
+        ld = dict(labels)
+        if name == "tick_stage_ms_count" and value > 0:
+            st = ld["stage"]
+            stages.setdefault(st, {})["count"] = int(value)
+        elif name == "tick_stage_ms_sum" and "stage" in ld:
+            stages.setdefault(ld["stage"], {})["sum_ms"] = value
+    for st, entry in stages.items():
+        if entry.get("count"):
+            entry["mean_ms"] = round(entry.pop("sum_ms", 0.0)
+                                     / entry["count"], 4)
+            entry["p50_ms"] = round(
+                histogram_quantile(d, "tick_stage_ms", 0.50, stage=st)
+                or 0.0, 4)
+            entry["p99_ms"] = round(
+                histogram_quantile(d, "tick_stage_ms", 0.99, stage=st)
+                or 0.0, 4)
+        else:
+            entry.pop("sum_ms", None)
+    return {st: e for st, e in sorted(stages.items()) if "count" in e}
+
+
+# ---------------------------------------------------------------------------
+# phase 1: live gateway under chaos
+# ---------------------------------------------------------------------------
+
+
+async def run_live_phase(p: TraceSoakParams, dump_dir: str) -> dict:
+    """A real gateway with tracing ON and chaos stalls blowing ticks;
+    returns the per-stage budgets + validated anomaly dumps."""
+    from channeld_tpu import chaos as chaos_mod  # noqa: F401
+    from channeld_tpu.chaos import arm, chaos, disarm
+    from channeld_tpu.chaos.invariants import delta, scrape
+    from channeld_tpu.core import channel as channel_mod
+    from channeld_tpu.core import connection as connection_mod
+    from channeld_tpu.core import data as data_mod
+    from channeld_tpu.core import ddos as ddos_mod
+    from channeld_tpu.core import connection_recovery as recovery_mod
+    from channeld_tpu.core.channel import init_channels
+    from channeld_tpu.core.connection import all_connections, init_connections
+    from channeld_tpu.core.ddos import init_anti_ddos, unauth_reaper_loop
+    from channeld_tpu.core.overload import reset_overload
+    from channeld_tpu.core.server import flush_loop, start_listening
+    from channeld_tpu.core.settings import (
+        ChannelSettings,
+        global_settings,
+        reset_global_settings,
+    )
+    from channeld_tpu.core.types import ChannelType, ConnectionType
+    from channeld_tpu.federation import reset_federation
+    from channeld_tpu.models.sim import register_sim_types
+    from channeld_tpu.ops.spatial_ops import AOI_SPHERE
+    from channeld_tpu.spatial.controller import (
+        get_spatial_controller,
+        init_spatial_controller,
+        reset_spatial_controller,
+    )
+
+    channel_mod.reset_channels()
+    connection_mod.reset_connections()
+    data_mod.reset_registries()
+    ddos_mod.reset_ddos()
+    recovery_mod.reset_recovery()
+    reset_spatial_controller()
+    reset_global_settings()
+    reset_overload()
+    reset_federation()
+
+    global_settings.development = True
+    global_settings.balancer_enabled = False
+    global_settings.federation_config = ""
+    # The ladder stays pinned at L0: boot-time jit compiles blow ticks,
+    # and on a loaded box the resulting climb reaches L3 before the
+    # client fleet auths — refusing the very traffic whose ingest this
+    # soak measures (the overload soak owns ladder behavior). The
+    # `overload` stage is still measured: governor.update runs, and
+    # tick_budget anomalies still fire, with the ladder disarmed.
+    global_settings.overload_enabled = False
+    global_settings.tpu_entity_capacity = 256
+    global_settings.tpu_query_capacity = 32
+    global_settings.channel_settings = {
+        ChannelType.GLOBAL: ChannelSettings(
+            tick_interval_ms=33, default_fanout_interval_ms=50),
+        ChannelType.SPATIAL: ChannelSettings(
+            tick_interval_ms=50, default_fanout_interval_ms=100),
+        ChannelType.ENTITY: ChannelSettings(
+            tick_interval_ms=50, default_fanout_interval_ms=100),
+    }
+    # The subject under test: span recording + anomaly auto-dumps ON.
+    global_settings.trace_enabled = True
+    recorder = _recorder()
+    recorder.configure(
+        enabled=True, ring_spans=16384, dump_ticks=150,
+        dump_path=dump_dir, anomaly_cooldown_s=2.0, origin="live",
+    )
+
+    register_sim_types()
+    init_connections(
+        os.path.join(REPO, "config", "server_authoritative_fsm.json"),
+        os.path.join(REPO, "config", "client_authoritative_fsm.json"),
+    )
+    init_channels()
+    init_anti_ddos()
+    init_spatial_controller(
+        os.path.join(REPO, "config", "spatial_tpu_cells_2x2.json"))
+    ctl = get_spatial_controller()
+
+    baseline = scrape()
+    arm(p.scenario)
+
+    host = "127.0.0.1"
+    server_srv = await start_listening(
+        ConnectionType.SERVER, "tcp", f"{host}:0")
+    server_port = server_srv.sockets[0].getsockname()[1]
+    client_srv = await start_listening(
+        ConnectionType.CLIENT, "tcp", f"{host}:0")
+    client_port = client_srv.sockets[0].getsockname()[1]
+
+    stop = asyncio.Event()
+    send_stop = asyncio.Event()
+    tasks = [
+        asyncio.ensure_future(flush_loop()),
+        asyncio.ensure_future(unauth_reaper_loop()),
+    ]
+    stats = live.SoakStats()
+    try:
+        (m_reader, m_writer, drain_task), spatial_socks = \
+            await live._boot_world(host, server_port, stats, stop)
+        tasks.append(drain_task)
+        tasks.extend(t for _, _, t in spatial_socks)
+
+        rng = Random(p.seed ^ 0x7247)
+        sim_params = live.SoakParams(
+            entities=p.entities, storm_size=p.storm_size)
+        sim = live.EntitySim(ctl, sim_params, rng)
+        sim.create_entities()
+
+        for idx in range(p.clients):
+            tasks.append(asyncio.ensure_future(live._client_loop(
+                idx, host, client_port, p.msg_rate, stats, stop, send_stop,
+            )))
+
+        # AOI followers on live CLIENT connections: the per-follower
+        # interested_cells readback (ROADMAP item 1) must appear in the
+        # timeline as the `readback` stage + follower_readbacks_total.
+        fdeadline = time.monotonic() + 10.0
+        followers = 0
+        while time.monotonic() < fdeadline and followers < p.followers:
+            for conn in list(all_connections().values()):
+                if followers >= p.followers:
+                    break
+                pit = getattr(conn, "pit", "") or ""
+                if pit.startswith("soak-client-") and not conn.is_closing() \
+                        and conn.id not in ctl._followers:
+                    ctl.register_follow_interest(
+                        conn, sim.entity_ids[followers % len(sim.entity_ids)],
+                        AOI_SPHERE, extent=(60.0, 0.0),
+                    )
+                    followers += 1
+            await asyncio.sleep(0.2)
+
+        # -- the live timeline: jitter + one storm (handover burst) --
+        t0 = time.monotonic()
+        stormed = False
+        crowd: list[int] = []
+        while time.monotonic() - t0 < p.live_s:
+            sim.jitter_step()
+            if not stormed and time.monotonic() - t0 > p.live_s * 0.3:
+                crowd = sim.storm_gather()
+                stormed = True
+            elif crowd and time.monotonic() - t0 > p.live_s * 0.7:
+                sim.disperse(crowd)
+                crowd = []
+            await asyncio.sleep(0.1)
+
+        send_stop.set()
+        fire_counts = dict(chaos.fire_counts())
+        disarm()
+        await asyncio.sleep(p.quiesce_s)
+
+        d = delta(scrape(), baseline)
+        # Only the anomalies that actually froze a dump go in the
+        # artifact (cooldown-suppressed ones are counted, not listed —
+        # on a loaded CPU box hundreds of ticks blow the 33ms budget).
+        dumps = []
+        anomalies_total: dict[str, int] = {}
+        for a in recorder.anomalies:
+            anomalies_total[a["trigger"]] = \
+                anomalies_total.get(a["trigger"], 0) + 1
+            if "path" in a:
+                ok, note = _check_perfetto(a["path"])
+                dumps.append({
+                    "trigger": a["trigger"], "tick": a["tick"],
+                    "detail": a["detail"],
+                    "path": os.path.basename(a["path"]),
+                    "perfetto_valid": ok, "note": note,
+                })
+        from channeld_tpu.chaos.invariants import sample_total
+
+        report = {
+            "stages": _stage_stats(d),
+            "anomaly_dumps": dumps,
+            "anomalies_total": anomalies_total,
+            "trace_dumps_total": {
+                trigger: int(sample_total(
+                    d, "trace_dumps_total", trigger=trigger))
+                for trigger in ("tick_budget",)
+                if sample_total(d, "trace_dumps_total", trigger=trigger)
+            },
+            "follower_readbacks_total": int(
+                sample_total(d, "follower_readbacks_total")),
+            "followers": followers,
+            "recorder": recorder.stats(),
+            "chaos_fires": fire_counts,
+            "clients": p.clients,
+            "entities": p.entities,
+            "frames_sent": sum(stats.client_sent.values()),
+        }
+        stop.set()
+        return report
+    finally:
+        stop.set()
+        send_stop.set()
+        disarm()
+        for t in tasks:
+            t.cancel()
+        server_srv.close()
+        client_srv.close()
+        channel_mod.reset_channels()
+        connection_mod.reset_connections()
+        data_mod.reset_registries()
+        ddos_mod.reset_ddos()
+        recovery_mod.reset_recovery()
+        reset_spatial_controller()
+        reset_global_settings()
+        reset_overload()
+
+
+# ---------------------------------------------------------------------------
+# phase 2: cross-gateway trace stitching (2 processes)
+# ---------------------------------------------------------------------------
+
+
+async def remote_main(args) -> None:
+    """Gateway b: the federation soak's boot, tracing re-enabled, and a
+    span report so the parent can stitch traces."""
+    with open(args.config) as f:
+        fed_cfg = json.load(f)
+    p = fed.FedSoakParams(heartbeat_ms=200, trunk_timeout_ms=1200,
+                          handover_timeout_ms=1500)
+    stop = asyncio.Event()
+    gw = await fed.boot_gateway("b", fed_cfg, p, stop)
+    from channeld_tpu.core.settings import global_settings
+
+    global_settings.trace_enabled = True
+    recorder = _recorder()
+    recorder.configure(enabled=True, ring_spans=16384,
+                       dump_path="/tmp", origin="b")
+    print("READY", flush=True)
+
+    loop = asyncio.get_running_loop()
+    reader = asyncio.StreamReader()
+    await loop.connect_read_pipe(
+        lambda: asyncio.StreamReaderProtocol(reader), sys.stdin
+    )
+    plane = gw["plane"]
+    while True:
+        line = await reader.readline()
+        if not line:
+            break
+        try:
+            cmd = json.loads(line)
+        except ValueError:
+            continue
+        name = cmd.get("cmd")
+        if name == "report":
+            spans = [s for s in recorder.snapshot() if s.get("trace")]
+            with open(args.report, "w") as f:
+                json.dump({
+                    "gateway": "b",
+                    "ledger": dict(plane.ledger),
+                    "spans": [
+                        {"name": s["name"], "trace": s["trace"],
+                         "tick": s["tick"]}
+                        for s in spans
+                    ],
+                }, f)
+            print("OK report", flush=True)
+        elif name == "exit":
+            break
+    stop.set()
+    fed.teardown_gateway(gw)
+
+
+async def run_federation_phase(p: TraceSoakParams, dump_dir: str) -> dict:
+    from channeld_tpu.core.settings import global_settings
+
+    ports = dict(zip(
+        ("a_trunk", "a_client", "b_trunk", "b_client"), fed._free_ports(4)
+    ))
+    fed_cfg = fed._fed_config(ports)
+    cfg_path = os.path.join("/tmp", f"trace_soak_cfg_{os.getpid()}.json")
+    report_path = os.path.join(
+        "/tmp", f"trace_soak_report_{os.getpid()}.json")
+    with open(cfg_path, "w") as f:
+        json.dump(fed_cfg, f)
+
+    child_proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--role", "remote",
+         "--config", cfg_path, "--report", report_path],
+        cwd=REPO, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    child = fed.Child(child_proc)
+    stop = asyncio.Event()
+    gw = None
+    fp = fed.FedSoakParams(heartbeat_ms=200, trunk_timeout_ms=1200,
+                           handover_timeout_ms=1500)
+    try:
+        await child.wait_for("READY", 60.0)
+        gw = await fed.boot_gateway("a", fed_cfg, fp, stop)
+        plane = gw["plane"]
+        ctl = gw["ctl"]
+        global_settings.trace_enabled = True
+        recorder = _recorder()
+        recorder.configure(enabled=True, ring_spans=16384,
+                           dump_path=dump_dir, anomaly_cooldown_s=0.5,
+                           origin="a")
+
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline and plane.link_to("b") is None:
+            await asyncio.sleep(0.05)
+        if plane.link_to("b") is None:
+            raise RuntimeError("trunk to b never came up")
+
+        rng = Random(p.seed ^ 0xF2)
+        sim = fed.FedSim(ctl, rng)
+        sim.create_entities(p.fed_burst + p.fed_sever_burst + 4,
+                            -98.0, -2.0, -98.0, 98.0)
+        await asyncio.sleep(0.5)
+
+        # -- committed burst: one trace id per batch crosses the trunk --
+        sim.herd(sim.entity_ids[: p.fed_burst], 2.0, 98.0, -98.0, 98.0)
+        cdeadline = time.monotonic() + 20.0
+        while time.monotonic() < cdeadline and \
+                plane.ledger.get("committed", 0) < p.fed_burst:
+            await asyncio.sleep(0.05)
+        committed = plane.ledger.get("committed", 0)
+
+        # -- sever mid-burst: the handover_abort anomaly dump --
+        sever_ids = sim.local_ids()[: p.fed_sever_burst]
+        sim.herd(sever_ids, 2.0, 98.0, -98.0, 98.0)
+        sdeadline = time.monotonic() + 5.0
+        severed = False
+        while time.monotonic() < sdeadline:
+            link = plane.link_to("b")
+            if plane._pending and link is not None:
+                link.sever_for_test()
+                severed = True
+                break
+            # 1ms poll, not sleep(0): a busy-spin here would peg the
+            # shared event loop and distort the very timings recorded.
+            await asyncio.sleep(0.001)
+        ddeadline = time.monotonic() + 30.0
+        while time.monotonic() < ddeadline and (
+            plane._pending or plane._parked
+        ):
+            await asyncio.sleep(0.1)
+        await asyncio.sleep(1.0)
+
+        await child.cmd("report", timeout=15.0)
+        with open(report_path) as f:
+            b_report = json.load(f)
+
+        a_spans = [
+            {"name": s["name"], "trace": s["trace"], "tick": s["tick"]}
+            for s in recorder.snapshot() if s.get("trace")
+        ]
+        b_spans = b_report["spans"]
+        a_traces = {s["trace"] for s in a_spans
+                    if s["name"] in ("fed.prepare", "fed.commit")}
+        b_traces = {s["trace"] for s in b_spans
+                    if s["name"] in ("fed.apply", "fed.refuse")}
+        stitched = sorted(a_traces & b_traces)
+        example = None
+        if stitched:
+            tid = stitched[0]
+            example = {
+                "trace_id": tid,
+                "a_spans": sorted(s["name"] for s in a_spans
+                                  if s["trace"] == tid),
+                "b_spans": sorted(s["name"] for s in b_spans
+                                  if s["trace"] == tid),
+            }
+        # Only anomalies that actually froze a dump (the cooldown
+        # rightly suppresses the burst's tail — one abort per cooldown
+        # window gets a timeline, the rest are counted).
+        abort_dumps = [
+            {"trigger": a["trigger"], "detail": a["detail"],
+             "path": os.path.basename(a["path"]),
+             "perfetto_valid": _check_perfetto(a["path"])[0]}
+            for a in recorder.anomalies
+            if a["trigger"] == "handover_abort" and "path" in a
+        ]
+        from channeld_tpu.chaos.invariants import scrape as _scrape
+
+        # The trunk stage only fires on trunk links, which exist only in
+        # this phase — a plain scrape is its exact per-phase total.
+        samples = _scrape()
+        trunk_stats = _stage_stats(samples).get("trunk", {})
+        trunk_stage_count = int(trunk_stats.get("count", 0))
+        return {
+            "trunk_stage": trunk_stats,
+            "committed": committed,
+            "severed": severed,
+            "aborted": plane.ledger.get("aborted", 0),
+            "stitched_traces": len(stitched),
+            "example": example,
+            "abort_dumps": abort_dumps,
+            "trunk_stage_samples": trunk_stage_count,
+            "b_ledger": b_report["ledger"],
+        }
+    finally:
+        stop.set()
+        try:
+            if child_proc.poll() is None:
+                try:
+                    child_proc.stdin.write('{"cmd": "exit"}\n')
+                    child_proc.stdin.flush()
+                except (BrokenPipeError, OSError):
+                    pass
+                try:
+                    child_proc.wait(timeout=8)
+                except subprocess.TimeoutExpired:
+                    child_proc.kill()
+        except Exception:
+            pass
+        if gw is not None:
+            fed.teardown_gateway(gw)
+        for path in (cfg_path, report_path):
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# phase 3: recorder overhead on the tick hot path
+# ---------------------------------------------------------------------------
+
+
+def run_overhead_phase(p: TraceSoakParams) -> dict:
+    """The GLOBAL tick hot path (device step + entity updates) timed
+    with the recorder enabled vs disabled — interleaved rounds, median
+    per-tick, so scheduler noise cancels instead of deciding the
+    verdict."""
+    from channeld_tpu.core import channel as channel_mod
+    from channeld_tpu.core.channel import init_channels
+    from channeld_tpu.core.settings import (
+        global_settings,
+        reset_global_settings,
+    )
+    from channeld_tpu.spatial.controller import (
+        SpatialInfo,
+        reset_spatial_controller,
+        set_spatial_controller,
+    )
+    from channeld_tpu.spatial.tpu_controller import TPUSpatialController
+
+    channel_mod.reset_channels()
+    reset_spatial_controller()
+    reset_global_settings()
+    global_settings.development = False
+    global_settings.tpu_entity_capacity = 256
+    global_settings.tpu_query_capacity = 16
+    # Comparable rounds: no governor ladder moves between the enabled
+    # and disabled runs, and no anomaly dump I/O inside the measurement
+    # window (the warmup tick compiles the engine and always "blows"
+    # its budget).
+    global_settings.overload_enabled = False
+
+    recorder = _recorder()
+    recorder.configure(enabled=True, ring_spans=16384, dump_path="/tmp",
+                       anomaly_cooldown_s=1e9)
+    # No dump I/O inside the measurement window at all: the huge
+    # cooldown alone still lets the FIRST blown tick (the jit-compile
+    # warmup) spawn a writer thread that competes for the single CPU
+    # core mid-round.
+    recorder._last_dump_at = time.monotonic()
+    init_channels()
+    gch = channel_mod.get_global_channel()
+    ctl = TPUSpatialController()
+    ctl.load_config(dict(
+        WorldOffsetX=0, WorldOffsetZ=0, GridWidth=100, GridHeight=100,
+        GridCols=4, GridRows=4, ServerCols=1, ServerRows=1,
+        ServerInterestBorderSize=0,
+    ))
+    set_spatial_controller(ctl)
+    rng = Random(p.seed ^ 0x0ffd)
+    estart = global_settings.entity_channel_id_start
+    eids = []
+    for i in range(64):
+        eid = estart + 1 + i
+        # Mid-cell positions: per-tick jitter stays inside the cell, so
+        # the loop measures the steady-state tick (device step + update
+        # intake), not handover orchestration.
+        x = (i % 4) * 100.0 + 50.0
+        z = (i // 4 % 4) * 100.0 + 50.0
+        ctl.track_entity(eid, SpatialInfo(x, 0, z))
+        eids.append((eid, x, z))
+
+    def one_tick() -> int:
+        for eid, x, z in rng.sample(eids, 8):
+            ctl.observe_entity(eid, SpatialInfo(
+                x + rng.uniform(-20, 20), 0, z + rng.uniform(-20, 20)))
+        t0 = time.perf_counter_ns()
+        gch.tick_once(gch.get_time())
+        return time.perf_counter_ns() - t0
+
+    for _ in range(30):  # jit warmup (compile the engine) off the clock
+        one_tick()
+    import gc
+
+    # Per-tick alternation: adjacent ticks share the same machine
+    # weather (co-runners, thermal state, allocator phase), so the
+    # enabled/disabled arms are paired instead of comparing rounds
+    # that ran seconds apart — round-scale drift on a busy shared CPU
+    # box was measured swinging whole-round medians by ±5-10%, far
+    # above the effect under test.
+    on_samples: list[int] = []
+    off_samples: list[int] = []
+    gc_was_enabled = gc.isenabled()
+    gc.disable()  # a collection landing in one arm skews the compare
+    try:
+        for _ in range(p.overhead_ticks * p.overhead_rounds):
+            recorder.enabled = True
+            on_samples.append(one_tick())
+            recorder.enabled = False
+            off_samples.append(one_tick())
+    finally:
+        if gc_was_enabled:
+            gc.enable()
+    recorder.enabled = True
+
+    # Raw span cost: the two clock reads + ring store the hot sites pay.
+    n = 100_000
+    t0 = time.perf_counter_ns()
+    for _ in range(n):
+        recorder.span("bench", recorder.now())
+    span_cost_ns = (time.perf_counter_ns() - t0) / n
+
+    tick_on = statistics.median(on_samples)
+    tick_off = statistics.median(off_samples)
+    overhead_pct = (tick_on - tick_off) / tick_off * 100.0
+
+    channel_mod.reset_channels()
+    reset_spatial_controller()
+    reset_global_settings()
+    recorder.reset()
+    return {
+        "tick_ns_enabled": int(tick_on),
+        "tick_ns_disabled": int(tick_off),
+        "overhead_pct": round(overhead_pct, 3),
+        "span_cost_ns": round(span_cost_ns, 1),
+        "ticks_per_round": p.overhead_ticks,
+        "rounds": p.overhead_rounds,
+        "method": "median per-tick over per-tick-alternated enabled/"
+                  "disabled arms of the synchronous GLOBAL tick "
+                  "(device step + 8 entity updates/tick, 64 tracked "
+                  "entities; gc off, no dump I/O in-window; adjacent "
+                  "alternation pairs both arms with the same machine "
+                  "weather)",
+    }
+
+
+# ---------------------------------------------------------------------------
+# the soak
+# ---------------------------------------------------------------------------
+
+
+async def run_trace_soak(p: TraceSoakParams) -> dict:
+    from channeld_tpu.chaos.invariants import InvariantChecker
+
+    t_start = time.monotonic()
+    dump_dir = os.path.join(REPO, "profiles")
+    live_report = await run_live_phase(p, dump_dir)
+    fed_report = None
+    if not p.skip_federation:
+        fed_report = await run_federation_phase(p, dump_dir)
+    overhead = run_overhead_phase(p)
+
+    inv = InvariantChecker()
+    stages = dict(live_report["stages"])
+    if fed_report is not None and fed_report.get("trunk_stage"):
+        stages["trunk"] = fed_report["trunk_stage"]
+    expected = list(TRACE_STAGES)
+    if fed_report is not None:
+        expected.append("trunk")
+    missing = [s for s in expected if s not in stages]
+    inv.expect_equal("every_tick_stage_measured", missing, [],
+                     f"stages seen: {sorted(stages)}")
+    budget_dumps = [dmp for dmp in live_report["anomaly_dumps"]
+                    if dmp["trigger"] == "tick_budget"]
+    inv.expect_gt("tick_budget_anomaly_dump_written",
+                  len(budget_dumps), 0)
+    inv.check("anomaly_dumps_are_valid_perfetto",
+              all(dmp.get("perfetto_valid", True)
+                  for dmp in live_report["anomaly_dumps"]),
+              str([dmp["path"] for dmp in live_report["anomaly_dumps"]]))
+    inv.expect_gt("follower_readbacks_counted",
+                  live_report["follower_readbacks_total"], 0)
+    if fed_report is not None:
+        inv.expect_gt("cross_gateway_trace_stitched",
+                      fed_report["stitched_traces"], 0)
+        inv.expect_gt("cross_gateway_committed",
+                      fed_report["committed"], 0)
+        inv.expect_gt("trunk_stage_measured",
+                      fed_report["trunk_stage_samples"], 0)
+        inv.check("handover_abort_anomaly_dumped",
+                  bool(fed_report["abort_dumps"])
+                  and all(dmp["perfetto_valid"]
+                          for dmp in fed_report["abort_dumps"]),
+                  str(fed_report["abort_dumps"]))
+    inv.expect_le("recorder_overhead_under_3pct",
+                  overhead["overhead_pct"], 3.0)
+
+    report = {
+        "kind": "trace_soak",
+        "duration_s": round(time.monotonic() - t_start, 2),
+        "params": {
+            "live_s": p.live_s, "clients": p.clients,
+            "entities": p.entities, "followers": p.followers,
+            "fed_burst": p.fed_burst, "seed": p.seed,
+        },
+        "scenario": p.scenario,
+        "stages": stages,
+        "anomaly_dumps": live_report["anomaly_dumps"]
+        + (fed_report["abort_dumps"] if fed_report else []),
+        "anomalies_total": live_report["anomalies_total"],
+        "trace_dumps_total": live_report["trace_dumps_total"],
+        "follower_readbacks_total":
+            live_report["follower_readbacks_total"],
+        "live": {k: live_report[k] for k in
+                 ("followers", "recorder", "chaos_fires", "clients",
+                  "entities", "frames_sent")},
+        "cross_gateway": (
+            {k: fed_report[k] for k in
+             ("committed", "severed", "aborted", "stitched_traces",
+              "example", "trunk_stage_samples")}
+            if fed_report else {"skipped": True}
+        ),
+        "overhead": overhead,
+        "invariants": inv.summary(),
+    }
+    if p.out_path:
+        with open(p.out_path, "w") as f:
+            json.dump(report, f, indent=2)
+    return report
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--role", choices=("soak", "remote"), default="soak")
+    ap.add_argument("--config", type=str, default="")
+    ap.add_argument("--report", type=str, default="")
+    ap.add_argument("--live-s", type=float, default=20.0)
+    ap.add_argument("--clients", type=int, default=16)
+    ap.add_argument("--entities", type=int, default=120)
+    ap.add_argument("--followers", type=int, default=8)
+    ap.add_argument("--skip-federation", action="store_true")
+    ap.add_argument("--out", type=str, default="")
+    args = ap.parse_args()
+    if args.role == "remote":
+        asyncio.run(remote_main(args))
+        return
+    p = TraceSoakParams(
+        live_s=args.live_s, clients=args.clients, entities=args.entities,
+        followers=args.followers, skip_federation=args.skip_federation,
+        out_path=args.out,
+    )
+    report = asyncio.run(run_trace_soak(p))
+    print(json.dumps(report, indent=2))
+    if not report["invariants"]["ok"]:
+        raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -51,13 +51,19 @@ if not any(
 
 
 @pytest.fixture(autouse=True)
-def _fresh_globals():
-    """Reset process-wide singletons between tests."""
-    from channeld_tpu.core import events, overload, settings
+def _fresh_globals(tmp_path):
+    """Reset process-wide singletons between tests. The flight recorder
+    stays enabled (it is always-on in production too) but dumps under
+    the test's tmp dir and starts each test with empty rings — anomaly
+    auto-dumps from one test must not land in the repo's profiles/ or
+    slow a later timing-sensitive test with a full-ring freeze."""
+    from channeld_tpu.core import events, overload, settings, tracing
     from channeld_tpu.spatial import balancer as balancer_mod
 
+    tracing.recorder.configure(dump_path=str(tmp_path))
     yield
     events.reset_all()
     settings.reset_global_settings()
     overload.reset_overload()
     balancer_mod.reset_balancer()
+    tracing.reset_tracing()

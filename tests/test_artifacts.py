@@ -1,0 +1,42 @@
+"""Tier-1 drift gate: every committed SOAK_*/BENCH_*/TRACE_* artifact
+matches its schema and every doc-referenced Prometheus metric exists in
+core/metrics.py (scripts/check_artifacts.py — the checker the CI story
+in doc/observability.md describes)."""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+import check_artifacts  # noqa: E402
+
+
+def test_committed_artifacts_match_their_schemas():
+    assert check_artifacts.check_artifacts() == []
+
+
+def test_doc_referenced_metrics_exist():
+    assert check_artifacts.check_doc_metrics() == []
+
+
+def test_new_artifact_without_schema_fails(tmp_path):
+    """The guard actually guards: an unknown SOAK_*.json is flagged."""
+    import json
+
+    (tmp_path / "SOAK_NOVEL_r99.json").write_text(json.dumps({"x": 1}))
+    errors = check_artifacts.check_artifacts(str(tmp_path))
+    assert any("no schema registered" in e for e in errors)
+
+
+def test_failing_invariants_artifact_is_flagged(tmp_path):
+    import json
+
+    (tmp_path / "SOAK_FED_r99.json").write_text(json.dumps({
+        "kind": "federation_soak",
+        "invariants": {"ok": False, "checks": []},
+        "census": {}, "gateway_a": {}, "gateway_b": {},
+        "redirect": {}, "timeline": [],
+    }))
+    errors = check_artifacts.check_artifacts(str(tmp_path))
+    assert any("failing invariants" in e for e in errors)
