@@ -261,6 +261,32 @@ class HandoverJournal:
     def in_flight_count(self) -> int:
         return len(self._in_flight)
 
+    def remote_in_flight(self, entity_id: int) -> bool:
+        """True while the entity's in-flight slot holds a CROSS-GATEWAY
+        record: local orchestration (and a second remote offer) must
+        skip it — the trunk ack tears it down on commit, the abort path
+        restores and re-offers it. Orchestrating the entity locally
+        mid-flight would double its data (the remote batch already
+        captured a copy)."""
+        rec = self._in_flight.get(entity_id)
+        return (
+            rec is not None and rec.remote
+            and rec.state in (PREPARED, REMOVED)
+        )
+
+    def in_flight_records(self) -> list[HandoverRecord]:
+        """ALL in-flight records, local hops included. The epoch
+        replica exports these: an entity mid-LOCAL-crossing sits in
+        NEITHER cell's data rows (removed from src, the dst add/commit
+        still queued), so a snapshot of cell data alone goes blind to
+        it — and a gateway killed with its final snapshot taken in that
+        window would lose the entity for good (the herding storms that
+        precede a death are exactly when crossings are densest)."""
+        return [
+            rec for rec in self._in_flight.values()
+            if rec.state in (PREPARED, REMOVED)
+        ]
+
     def in_flight_touching(self, channel_id: int) -> int:
         """In-flight handover records reading or writing one spatial
         channel — the balancer's drain barrier: a cell migration only

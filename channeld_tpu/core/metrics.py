@@ -242,6 +242,55 @@ trunk_rtt_ms = Histogram(
     registry=registry,
 )
 
+# Global control plane (federation/control.py; doc/global_control.md).
+global_migrations = Counter(
+    "global_migrations",
+    "Leader-planned cross-gateway shard migrations by result "
+    "(planned: a plan was opened — every plan also lands exactly one "
+    "terminal committed/aborted/refused, so sum terminal labels, not "
+    "the whole family; committed: the cell's residents drained to the "
+    "destination "
+    "gateway over the trunk and the source copy was torn down; "
+    "aborted: the drain never completed — trunk loss, deadline, or the "
+    "world changed — and the directory override reverted to the "
+    "source; refused: the destination refused the drain at overload "
+    "L3; vetoed: never planned because the overload ladder sat at L2+ "
+    "on either end. Counted on the LEADER that owns the plan; the "
+    "python ledger in federation/control.py must match exactly)",
+    ["result"],
+    registry=registry,
+)
+gateway_adoptions = Counter(
+    "gateway_adoptions",
+    "Dead gateways whose shard this gateway adopted (cell channels "
+    "recreated from the trunk-replicated epoch snapshot, in-flight "
+    "journal records replayed source-wins, staged recovery handles "
+    "re-staged so redirected clients resume without re-auth); the "
+    "python ledger in federation/control.py must match exactly",
+    registry=registry,
+)
+gateway_deaths = Counter(
+    "gateway_deaths",
+    "Gateway-death declarations processed on this gateway (the leader "
+    "declares after global_death_miss_epochs of trunk silence; every "
+    "survivor counts the TrunkGatewayDeadMessage it acted on)",
+    registry=registry,
+)
+global_imbalance = Gauge(
+    "global_imbalance",
+    "Fleet-level per-gateway load imbalance (max/mean of the "
+    "entities+crossings+pressure fold over every live gateway's "
+    "exported load vector; 1.0 == perfectly even; leader-computed)",
+    registry=registry,
+)
+shard_replica_entities = Gauge(
+    "shard_replica_entities",
+    "Entities held in trunk-replicated peer-shard snapshots on this "
+    "gateway (the adoption bootstrap material; refreshed every control "
+    "epoch per live peer)",
+    registry=registry,
+)
+
 # Overload-control plane (core/overload.py; doc/overload.md).
 overload_level = Gauge(
     "overload_level",
@@ -306,11 +355,12 @@ trace_dumps = Counter(
 )
 follower_readbacks = Counter(
     "follower_readbacks",
-    "Device->host interested_cells readbacks performed by "
-    "_apply_follow_interests — today one per AOI-following connection "
-    "per pass (ROADMAP item 1's measured bottleneck, ~330us each); the "
-    "batched-readback optimization must collapse this toward O(1) per "
-    "tick",
+    "Device->host interest-mask transfers performed by "
+    "_apply_follow_interests — one BATCHED transfer per pass covering "
+    "every AOI follower (engine.interested_cells_batch). Before the "
+    "batching this counted one transfer per follower per pass "
+    "(ROADMAP item 1's measured bottleneck, ~330us each; "
+    "BENCH_RESULTS.md round 12 has the before/after)",
     registry=registry,
 )
 

@@ -231,6 +231,40 @@ class GlobalSettings:
     federation_reconnect_base_ms: int = 100
     federation_reconnect_max_ms: int = 5000
 
+    # Global control plane (new — doc/global_control.md). Only armed
+    # when the federation plane is (it rides the trunks): each gateway
+    # exports a load vector + replicates its shard state to every trunk
+    # peer once per control epoch; the deterministic leader (lowest
+    # live gateway id) folds the vectors into a fleet max/mean
+    # imbalance and plans per-cell cross-gateway shard migrations with
+    # the balancer's guard discipline (hysteresis, budget, cooldown,
+    # improvement, hard veto at overload L2+); a gateway whose trunks
+    # stay silent past the miss threshold is declared dead by the
+    # leader and its shard is adopted by the least-loaded survivor from
+    # the epoch replica.
+    global_control_enabled: bool = True
+    global_epoch_ms: int = 500
+    global_imbalance_enter: float = 1.5
+    global_imbalance_exit: float = 1.2
+    global_hold_epochs: int = 3
+    # Committed shard migrations allowed per budget window, and the
+    # window itself (in control epochs).
+    global_budget_per_window: int = 2
+    global_budget_window_epochs: int = 20
+    # Per-cell re-migration lockout after a terminal plan, in epochs.
+    global_cooldown_epochs: int = 20
+    # Hottest-coldest per-gateway entity gap below which the fleet is
+    # too small/even to be worth moving shards around.
+    global_min_entity_delta: int = 8
+    # Consecutive epochs a peer's trunk must stay down before the
+    # leader declares it dead and reassigns its shard.
+    global_death_miss_epochs: int = 4
+    # One shard-migration plan's leader-side deadline (plan -> terminal
+    # TrunkMigrateStatus), and the adoption census handshake's wait for
+    # survivor claims.
+    global_migrate_timeout_ms: int = 8000
+    global_adopt_claims_timeout_ms: int = 750
+
     # Flight recorder (new — doc/observability.md). Always-on by
     # default: the recorder is fixed-memory (per-thread span rings) and
     # its hot-path cost is two clock reads + a ring store per tick
@@ -392,6 +426,27 @@ class GlobalSettings:
                             "disables the federation plane")
         p.add_argument("-fed-id", type=str, default="",
                        help="this gateway's id in the federation config")
+        p.add_argument("-global-control",
+                       type=lambda s: s.lower() not in
+                       ("false", "0", "no", "off"),
+                       default=self.global_control_enabled,
+                       help="federation-level control plane: cross-"
+                            "gateway shard rebalancing + gateway-death "
+                            "failover (doc/global_control.md); false "
+                            "pins the static shard map")
+        p.add_argument("-global-epoch-ms", type=int,
+                       default=self.global_epoch_ms,
+                       help="control-epoch cadence: load-vector export, "
+                            "shard replication, leader planning")
+        p.add_argument("-global-imbalance", type=float,
+                       default=self.global_imbalance_enter,
+                       help="max/mean per-gateway load ratio above which "
+                            "the leader plans a shard migration")
+        p.add_argument("-global-death-epochs", type=int,
+                       default=self.global_death_miss_epochs,
+                       help="consecutive control epochs a trunk must "
+                            "stay down before the leader declares the "
+                            "gateway dead and re-hosts its shard")
         p.add_argument("-trace",
                        type=lambda s: s.lower() not in
                        ("false", "0", "no", "off"),
@@ -460,6 +515,15 @@ class GlobalSettings:
         self.balancer_cooldown_ticks = args.balancer_cooldown
         self.federation_config = args.fed
         self.federation_gateway_id = args.fed_id
+        self.global_control_enabled = args.global_control
+        self.global_epoch_ms = args.global_epoch_ms
+        self.global_imbalance_enter = args.global_imbalance
+        # Same hysteresis-band guard as the balancer flag: the exit
+        # threshold must stay strictly under the enter threshold.
+        self.global_imbalance_exit = min(
+            self.global_imbalance_exit, args.global_imbalance * 0.85
+        )
+        self.global_death_miss_epochs = args.global_death_epochs
         self.trace_enabled = args.trace
         self.trace_ring_spans = args.trace_ring
         self.trace_dump_ticks = args.trace_dump_ticks

@@ -81,6 +81,16 @@ class MessageType(IntEnum):
     TRUNK_STAGE_REDIRECT = 35
     TRUNK_STAGE_ACK = 36
     TRUNK_DIRECTORY_UPDATE = 37
+    # Global control plane (federation/control.py, 38-45;
+    # doc/global_control.md).
+    TRUNK_LOAD_REPORT = 38
+    TRUNK_SHARD_EPOCH = 39
+    TRUNK_SHARD_MIGRATE = 40
+    TRUNK_MIGRATE_STATUS = 41
+    TRUNK_GATEWAY_DEAD = 42
+    TRUNK_ADOPT_DONE = 43
+    TRUNK_ADOPT_QUERY = 44
+    TRUNK_ADOPT_CLAIMS = 45
     DEBUG_GET_SPATIAL_REGIONS = 99
     USER_SPACE_START = 100
 

@@ -144,9 +144,54 @@ class ShardDirectory:
         )
         return True
 
+    def replace_update(self, overrides: dict[int, str],
+                       version: int) -> Optional[dict[int, str]]:
+        """Full-map anti-entropy sync from the leader (partition heal):
+        REPLACES the override map, so overrides minted by a partitioned
+        concurrent leader are dropped rather than merely out-versioned.
+        Returns the {cell: now-authoritative gateway} map of every cell
+        whose mapping changed (for the control plane's cell lifecycle),
+        or None for stale versions."""
+        if version <= self._override_version:
+            logger.warning(
+                "stale directory replace v%d ignored (at v%d)",
+                version, self._override_version,
+            )
+            return None
+        old = self._overrides
+        self._override_version = version
+        self._overrides = dict(overrides)
+        changed: dict[int, str] = {}
+        for cid in set(old) | set(overrides):
+            if old.get(cid) != overrides.get(cid):
+                gw = self.gateway_of_cell(cid)
+                if gw is not None:
+                    changed[cid] = gw
+        logger.info(
+            "directory replaced at v%d: %d cell overrides active, "
+            "%d mappings changed", version, len(self._overrides),
+            len(changed),
+        )
+        return changed
+
     @property
     def override_version(self) -> int:
         return self._override_version
+
+    def overrides(self) -> dict[int, str]:
+        """Copy of the active per-cell overrides (the control plane's
+        directory re-sync to a returned gateway sends these verbatim)."""
+        return dict(self._overrides)
+
+    def server_index_of(self, cell_channel_id: int) -> Optional[int]:
+        """The cell's geometric server index via the attached resolver;
+        None outside the grid or before a resolver is attached."""
+        if self._resolver is None:
+            return None
+        try:
+            return self._resolver(cell_channel_id)
+        except ValueError:
+            return None
 
     def report(self) -> dict:
         return {

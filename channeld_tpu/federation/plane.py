@@ -60,6 +60,7 @@ from ..core.types import (
 from ..protocol import control_pb2, spatial_pb2
 from ..utils.anyutil import pack_any, unpack_any
 from ..utils.logger import get_logger
+from .control import append_event, control as global_control
 from .directory import directory
 from .trunk import TrunkManager
 
@@ -116,14 +117,21 @@ class FederationPlane:
         # Initiator state.
         self._pending: dict[int, PendingBatch] = {}
         self._parked: dict[int, ParkedCrossing] = {}
-        # peer -> {batch id: first-queued monotonic ts}; re-flushed
-        # until the TTL (see ABORT_NOTICE_TTL_S).
-        self._abort_notices: dict[str, dict[int, float]] = {}
+        # peer -> {(initiator, batch id): first-queued monotonic ts};
+        # re-flushed until the TTL (see ABORT_NOTICE_TTL_S). Initiator
+        # "" = this gateway; the control plane queues notices on a DEAD
+        # initiator's behalf under its id (batch ids are per-initiator
+        # counters — the receiver resolves against (initiator, id)).
+        self._abort_notices: dict[str, dict[tuple, float]] = {}
         self._notices_flushed_at: dict[str, float] = {}
         self._pending_redirects: dict[str, tuple] = {}  # pit -> (conn, eid, dst)
         self.client_anchors: dict[int, tuple] = {}  # conn id -> (conn, entity)
-        # Receiver state.
-        self._applied: OrderedDict[int, tuple] = OrderedDict()
+        # Receiver state: (initiator gateway, batch id) -> (dst cell,
+        # entity ids). Batch ids are per-initiator counters — a bare-id
+        # key would collide across initiators (fatal once a dead
+        # gateway's registry is adopted: a third gateway's abort notice
+        # would purge the WRONG batch's entities).
+        self._applied: OrderedDict[tuple, tuple] = OrderedDict()
         # Double-entry accounting: this ledger must match
         # federation_handover_total{result} exactly.
         self.ledger: dict[str, int] = {}
@@ -140,6 +148,9 @@ class FederationPlane:
 
         metrics.federation_handover.labels(result=result).inc(n)
 
+    def _event(self, e: dict) -> None:
+        append_event(self.events, e)
+
     # ---- lifecycle -------------------------------------------------------
 
     async def start(self) -> None:
@@ -152,6 +163,11 @@ class FederationPlane:
         await self.manager.start()
         self._tasks = [asyncio.ensure_future(self._timeout_loop())]
         self.active = True
+        if global_settings.global_control_enabled:
+            # The global control plane rides the trunks: load-vector
+            # export, shard replication, leader planning, death
+            # detection (doc/global_control.md).
+            global_control.start(self)
         logger.info(
             "federation plane up: gateway %s hosting server indices %s, "
             "peers %s", directory.local_id,
@@ -160,6 +176,7 @@ class FederationPlane:
 
     def stop(self) -> None:
         self.active = False
+        global_control.stop()
         for t in self._tasks:
             t.cancel()
         self._tasks = []
@@ -271,6 +288,11 @@ class FederationPlane:
         from ..core import metrics
 
         metrics.handover_count.inc(len(handover_entities))
+        global_control.note_crossing(len(handover_entities))
+        # Eager replica delta BEFORE the prepare: if this gateway dies
+        # with the prepare undelivered, some survivor's replica still
+        # carries the batch for the adoption's source-wins replay.
+        global_control.replicate_txns(records, peer, batch_id)
         sent = link.send(MessageType.TRUNK_HANDOVER_PREPARE, msg)
         # Prepare-side work on the initiator (journal prepare, src
         # remove, fan-out, trunk write), under the batch's trace id.
@@ -359,15 +381,18 @@ class FederationPlane:
         self._count("aborted", len(batch.records))
         if busy is not None:
             self._count("refused")  # batches, == busy frames received
-        self._abort_notices.setdefault(batch.peer, {})[batch.batch_id] = \
-            time.monotonic()
+        global_control.note_batch_aborted(batch, busy is not None)
+        self._abort_notices.setdefault(batch.peer, {})[
+            ("", batch.batch_id)
+        ] = time.monotonic()
         link = self.link_to(batch.peer)
         if link is not None:
             self._flush_abort_notices(batch.peer, link)
-        self.events.append({
+        self._event({
             "kind": "abort", "batch": batch.batch_id, "peer": batch.peer,
             "reason": reason, "entities": len(batch.records),
             "restored": restored,
+            "ids": [r.entity_id for r in batch.records],
         })
         if _trace.enabled:
             _trace.instant("fed.abort", trace=batch.trace_id or None)
@@ -412,9 +437,16 @@ class FederationPlane:
                 self._stage_redirect(conn, eid, batch)
                 redirected.append(conn_id)
         self._count("committed", len(batch.records))
-        self.events.append({
+        # Commit retention (doc/global_control.md): the peer now holds
+        # the only live copy; keep the batch until the peer's shard
+        # replica covers it — the resurrection material if it dies
+        # first.
+        global_control.note_batch_committed(batch)
+        self._event({
             "kind": "commit", "batch": batch.batch_id, "peer": batch.peer,
             "entities": len(batch.records), "redirect_conns": redirected,
+            "ids": [r.entity_id for r in batch.records],
+            "src": batch.src_channel_id, "dst": batch.dst_channel_id,
         })
         _trace.span("fed.commit", commit_start,
                     trace=batch.trace_id or None)
@@ -478,7 +510,7 @@ class FederationPlane:
 
         metrics.redirects.inc()
         self.ledger["redirects"] = self.ledger.get("redirects", 0) + 1
-        self.events.append({
+        self._event({
             "kind": "redirect", "pit": conn.pit, "peer": peer,
             "entity": entity_id, "staged": staged,
         })
@@ -652,13 +684,15 @@ class FederationPlane:
                 moved_hook(list(adopted), msg.dstChannelId)
 
         self._dst_fanout(dst_ch, msg.srcChannelId, msg.dstChannelId, adopted)
-        self._applied[msg.batchId] = (msg.dstChannelId, list(adopted))
+        self._applied[(peer, msg.batchId)] = (msg.dstChannelId,
+                                              list(adopted))
         while len(self._applied) > MAX_APPLIED_BATCHES:
             self._applied.popitem(last=False)
         self._count("applied", len(adopted))
-        self.events.append({
+        self._event({
             "kind": "applied", "batch": msg.batchId, "peer": peer,
             "entities": len(adopted), "dst": msg.dstChannelId,
+            "ids": list(adopted),
         })
         _ack(True)
 
@@ -768,8 +802,12 @@ class FederationPlane:
         from ..core.channel import get_channel, remove_channel
 
         purged = 0
+        purged_ids: list[int] = []
+        # Batch ids are per-initiator: the notice names its initiator
+        # when sent on a dead gateway's behalf, else it IS the sender.
+        initiator = msg.initiator or peer
         for batch_id in msg.batchIds:
-            applied = self._applied.pop(batch_id, None)
+            applied = self._applied.pop((initiator, batch_id), None)
             if applied is None:
                 continue
             _dst_cid, eids = applied
@@ -781,10 +819,12 @@ class FederationPlane:
                 if ech is not None and not ech.is_removing():
                     remove_channel(ech)
                 purged += 1
+                purged_ids.append(eid)
         if purged:
             self._count("reconciled", purged)
-            self.events.append({
+            self._event({
                 "kind": "reconciled", "peer": peer, "entities": purged,
+                "ids": purged_ids,
             })
             logger.warning(
                 "reconciled %d entities from %s's abort notices "
@@ -843,12 +883,33 @@ class FederationPlane:
         elif msg_type == MessageType.TRUNK_STAGE_ACK:
             self._on_stage_ack(peer, msg)
         elif msg_type == MessageType.TRUNK_DIRECTORY_UPDATE:
-            directory.apply_update(
-                {o.channelId: o.gatewayId for o in msg.overrides},
-                msg.version,
-            )
+            overrides = {o.channelId: o.gatewayId for o in msg.overrides}
+            if msg.replaceOverrides:
+                # Leader anti-entropy full sync: REPLACES the map, and
+                # the lifecycle below runs for every changed mapping —
+                # including overrides this gateway minted while
+                # partitioned that the leader's map no longer carries.
+                changed = directory.replace_update(overrides, msg.version)
+            else:
+                changed = overrides if directory.apply_update(
+                    overrides, msg.version) else None
+            if changed and global_control.active:
+                # Cells newly mapped here come up; cells mapped away
+                # while still hosted (returned-zombie) purge — channel
+                # mutations, so inside the GLOBAL tick.
+                self._in_global_tick(
+                    lambda: global_control.on_directory_update(changed)
+                )
         elif msg_type == MessageType.TRUNK_HELLO:
             pass  # re-hello after establishment: harmless
+        elif MessageType.TRUNK_LOAD_REPORT <= msg_type \
+                <= MessageType.TRUNK_ADOPT_CLAIMS:
+            # Global-control traffic (38-45): channel mutations, so it
+            # dispatches inside the GLOBAL tick like handover traffic.
+            self._in_global_tick(
+                lambda: global_control.on_trunk_message(peer, msg_type,
+                                                        msg)
+            )
         else:
             logger.error("unhandled trunk msgType %d from %s",
                          msg_type, peer)
@@ -887,12 +948,14 @@ class FederationPlane:
 
     def _on_trunk_up(self, peer: str, link) -> None:
         self._flush_abort_notices(peer, link)
+        global_control.on_trunk_up(peer)
         # Re-offer parked crossings bound for this peer.
         self._in_global_tick(lambda: self._reoffer_parked(peer))
-        self.events.append({"kind": "trunk_up", "peer": peer})
+        self._event({"kind": "trunk_up", "peer": peer})
 
     def _on_trunk_down(self, peer: str, link) -> None:
-        self.events.append({"kind": "trunk_down", "peer": peer})
+        global_control.on_trunk_down(peer)
+        self._event({"kind": "trunk_down", "peer": peer})
 
         def _abort_all():
             for batch in [b for b in self._pending.values()
@@ -910,23 +973,34 @@ class FederationPlane:
         if not notices:
             return
         now = time.monotonic()
-        for batch_id in [b for b, t0 in notices.items()
-                         if now - t0 > ABORT_NOTICE_TTL_S]:
-            del notices[batch_id]
+        for key in [k for k, t0 in notices.items()
+                    if now - t0 > ABORT_NOTICE_TTL_S]:
+            del notices[key]
         if not notices:
             return
         self._notices_flushed_at[peer] = now
-        link.send(
-            MessageType.TRUNK_ABORT_NOTICE,
-            control_pb2.TrunkAbortNoticeMessage(batchIds=list(notices)),
-        )
+        # One message per initiator (the receiver's registry is keyed
+        # (initiator, batch id); "" = this gateway, resolved to the
+        # sender on the far end).
+        by_initiator: dict[str, list[int]] = {}
+        for initiator, batch_id in notices:
+            by_initiator.setdefault(initiator, []).append(batch_id)
+        for initiator, batch_ids in by_initiator.items():
+            link.send(
+                MessageType.TRUNK_ABORT_NOTICE,
+                control_pb2.TrunkAbortNoticeMessage(
+                    batchIds=batch_ids, initiator=initiator),
+            )
 
     # ---- re-offer / timeout machinery ------------------------------------
 
     def _reoffer_parked(self, peer: Optional[str] = None) -> None:
         from ..core.channel import get_channel
+        from ..core.failover import journal
         from ..spatial.controller import get_spatial_controller
 
+        ctl = get_spatial_controller()
+        ledger = getattr(ctl, "_data_cell", {})
         now = time.monotonic()
         for eid, parked in list(self._parked.items()):
             if parked.not_before > now:
@@ -934,6 +1008,16 @@ class FederationPlane:
             if get_channel(eid) is None:
                 del self._parked[eid]  # entity destroyed while parked
                 continue
+            if journal.pending_dst(eid) is not None \
+                    or journal.remote_in_flight(eid):
+                continue  # mid-flight elsewhere: next sweep re-checks
+            # The parked src can be STALE: a local crossing orchestrated
+            # while the entity waited moved its data to another cell
+            # (the park only freezes the trunked hop, not the entity).
+            # Removing from the parked src would leave the live copy
+            # behind as a duplicate — the placement ledger has the
+            # authoritative cell.
+            src = ledger.get(eid, parked.src_channel_id)
             dst_peer = directory.gateway_of_cell(parked.dst_channel_id)
             if dst_peer is None or dst_peer == directory.local_id:
                 # A directory override re-shard landed the dst cell on
@@ -941,12 +1025,14 @@ class FederationPlane:
                 # plain local crossing now — run it through local
                 # orchestration instead of stranding it forever.
                 del self._parked[eid]
-                ctl = get_spatial_controller()
+                if parked.dst_channel_id == src:
+                    # A reverted shard migration (or the data already
+                    # chained into the dst cell): nothing to move.
+                    continue
                 orchestrate = getattr(ctl, "_orchestrate_pair", None)
                 if orchestrate is not None and get_channel(
                         parked.dst_channel_id) is not None:
-                    orchestrate(parked.src_channel_id,
-                                parked.dst_channel_id,
+                    orchestrate(src, parked.dst_channel_id,
                                 [lambda s, d, e=eid: e])
                 continue
             if peer is not None and dst_peer != peer:
@@ -954,9 +1040,10 @@ class FederationPlane:
             if self.link_to(dst_peer) is None:
                 continue
             del self._parked[eid]
+            if src == parked.dst_channel_id:
+                continue  # data already sits in the dst cell
             self.initiate_handover(
-                parked.src_channel_id, parked.dst_channel_id,
-                [lambda s, d, e=eid: e],
+                src, parked.dst_channel_id, [lambda s, d, e=eid: e],
             )
 
     async def _timeout_loop(self) -> None:
@@ -1043,4 +1130,5 @@ def reset_federation() -> None:
     """Test hook (also the disarm path)."""
     plane.stop()
     plane.reset()
+    global_control.reset()
     directory.reset()

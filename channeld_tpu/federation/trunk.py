@@ -52,6 +52,15 @@ TRUNK_MESSAGES = {
     MessageType.TRUNK_STAGE_REDIRECT: control_pb2.TrunkStageRedirectMessage,
     MessageType.TRUNK_STAGE_ACK: control_pb2.TrunkStageAckMessage,
     MessageType.TRUNK_DIRECTORY_UPDATE: control_pb2.TrunkDirectoryUpdateMessage,
+    # Global control plane (federation/control.py; doc/global_control.md).
+    MessageType.TRUNK_LOAD_REPORT: control_pb2.TrunkLoadReportMessage,
+    MessageType.TRUNK_SHARD_EPOCH: control_pb2.TrunkShardEpochMessage,
+    MessageType.TRUNK_SHARD_MIGRATE: control_pb2.TrunkShardMigrateMessage,
+    MessageType.TRUNK_MIGRATE_STATUS: control_pb2.TrunkMigrateStatusMessage,
+    MessageType.TRUNK_GATEWAY_DEAD: control_pb2.TrunkGatewayDeadMessage,
+    MessageType.TRUNK_ADOPT_DONE: control_pb2.TrunkAdoptDoneMessage,
+    MessageType.TRUNK_ADOPT_QUERY: control_pb2.TrunkAdoptQueryMessage,
+    MessageType.TRUNK_ADOPT_CLAIMS: control_pb2.TrunkAdoptClaimsMessage,
 }
 
 
@@ -103,6 +112,9 @@ class TrunkLink:
         self._last_rx = time.monotonic()
         self.alive = True
         self.established_at = time.monotonic()
+        # EWMA of the heartbeat RTT, exported in the control plane's
+        # load vector (doc/global_control.md); 0.0 until the first ack.
+        self.rtt_ms = 0.0
 
     def start(self) -> None:
         for mp in self._pending:
@@ -208,6 +220,10 @@ class TrunkLink:
             rtt_ms = time.monotonic() * 1000.0 - msg.sentAtMs
             if 0 <= rtt_ms < 60_000:
                 metrics.trunk_rtt_ms.observe(rtt_ms)
+                self.rtt_ms = (
+                    rtt_ms if self.rtt_ms == 0.0
+                    else 0.25 * rtt_ms + 0.75 * self.rtt_ms
+                )
         else:
             self.send(
                 MessageType.TRUNK_HEARTBEAT,

@@ -535,3 +535,31 @@ class SpatialEngine:
         dist = np.asarray(result["dist"][q])
         cells = np.nonzero(interest)[0]
         return {int(c): int(dist[c]) for c in cells}
+
+    def interested_cells_batch(
+        self, result: dict, conn_ids
+    ) -> dict[int, dict[int, int]]:
+        """{conn_id: {cell_index: grid_distance}} for MANY queries in one
+        device->host transfer of the whole interest + dist tables.
+
+        The per-connection form above pulls one row per call — one
+        device round-trip per AOI follower per tick, measured at
+        ~330us/follower (BENCH_RESULTS.md round 10, ROADMAP item 1):
+        past ~100 followers that alone blew the 33ms GLOBAL tick. The
+        masks already live in two device arrays, so the follower pass
+        fetches them once and slices rows on host — O(1) transfers per
+        tick regardless of follower count."""
+        rows = [
+            (cid, q) for cid in conn_ids
+            if (q := self._q_of_conn.get(cid)) is not None
+        ]
+        if not rows:
+            return {}
+        interest = np.asarray(result["interest"])
+        dist = np.asarray(result["dist"])
+        out: dict[int, dict[int, int]] = {}
+        for cid, q in rows:
+            cells = np.nonzero(interest[q])[0]
+            drow = dist[q]
+            out[cid] = {int(c): int(drow[c]) for c in cells}
+        return out

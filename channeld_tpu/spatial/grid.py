@@ -56,6 +56,17 @@ class StaticGrid2DSpatialController:
         self.server_interest_border_size = 0
         self.server_connections: list = []
         self._grid_size = 0.0
+        # Authoritative placement ledger: entity id -> the spatial cell
+        # channel whose DATA currently holds the entity. Crossing
+        # detection works from positions (host) or the device prev-cell
+        # table (TPU) — both can disagree with where the data actually
+        # sits (an entity applied into a cell by a trunked handover or
+        # an adoption bootstrap hasn't been position-sighted yet), and a
+        # remove aimed at the wrong src cell leaves the data duplicated
+        # across two cells. Flipped only when a move is REAL: the
+        # orchestration commit hook, the federation apply/restore paths,
+        # and the failover re-host re-seed.
+        self._data_cell: dict[int, int] = {}
 
     # ---- config ----------------------------------------------------------
 
@@ -77,6 +88,26 @@ class StaticGrid2DSpatialController:
             raise ValueError("GridCols and GridRows should be positive")
         if self.server_cols <= 0 or self.server_rows <= 0:
             raise ValueError("ServerCols and ServerRows should be positive")
+        from ..core import events
+
+        def _on_channel_removed(channel_id: int) -> None:
+            if channel_id >= global_settings.entity_channel_id_start:
+                self.untrack_entity(channel_id)
+
+        events.channel_removed.listen_for(self, _on_channel_removed)
+
+    def untrack_entity(self, entity_id: int) -> None:
+        """The entity's channel is gone: drop its placement-ledger row
+        (a reused entity id must never inherit the old row — notify()
+        would re-route the new entity's remove at a cell that holds no
+        copy, stranding the real one as a duplicate), moot any in-flight
+        journal transaction, and clear balancer freeze state. The TPU
+        subclass adds device-side cleanup on top."""
+        from ..core.failover import journal as _journal
+
+        self._data_cell.pop(entity_id, None)
+        _journal.forget_entity(entity_id)
+        _balancer._frozen_crossings.pop(entity_id, None)
 
     # ---- geometry --------------------------------------------------------
 
@@ -449,6 +480,31 @@ class StaticGrid2DSpatialController:
             return
         if src_channel_id == dst_channel_id:
             return
+        # Position-derived src vs the authoritative placement ledger:
+        # an entity applied here by a trunked handover / adoption
+        # bootstrap has data in a cell its position history knows
+        # nothing about — orchestrating from the position's src would
+        # leave that data behind as a stale duplicate. Same discipline
+        # as the TPU tick path (tpu_controller.tick): the in-flight
+        # journal outranks the committed ledger.
+        from ..core.failover import journal as _jrn
+
+        eid = handover_data_provider(-1, -1)
+        if eid is not None:
+            if _jrn.remote_in_flight(eid):
+                # Mid cross-gateway flight: commit removes the entity
+                # here; abort restores and re-offers it. Orchestrating
+                # this hop now would duplicate the data.
+                return
+            known = _jrn.pending_dst(eid)
+            if known is None:
+                known = self._data_cell.get(eid)
+            if known is not None and known != src_channel_id:
+                if known == dst_channel_id:
+                    return  # stale re-detection: the data already moved
+                # Chained hop: per-channel FIFO puts this remove after
+                # the pending add on `known`.
+                src_channel_id = known
         frozen = _balancer.frozen_cells
         if frozen or _balancer._frozen_crossings:
             # A live migration has a cell frozen: park crossings that
@@ -460,7 +516,6 @@ class StaticGrid2DSpatialController:
             # federated handover out of a frozen src cell would mutate
             # the cell mid-migration (the packed-state bootstrap could
             # ship an entity the trunk just moved).
-            eid = handover_data_provider(-1, -1)
             if eid is not None and (
                 src_channel_id in frozen
                 or dst_channel_id in frozen
@@ -483,6 +538,36 @@ class StaticGrid2DSpatialController:
             return
         self._orchestrate_pair(src_channel_id, dst_channel_id,
                                [handover_data_provider])
+
+    def _note_entity_data_moved(self, entity_ids, dst_channel_id: int) -> None:
+        """Placement-ledger callback: fires only when entity data
+        ACTUALLY moved (a skipped orchestration — missing channel,
+        locked group — must leave the ledger on the cell the data still
+        lives in, or stale re-detections would be mis-suppressed and
+        the data stranded). Called from the local orchestration's
+        commit hook, the federation apply/restore paths, and the
+        global-control adoption bootstrap."""
+        for eid in entity_ids:
+            self._data_cell[eid] = dst_channel_id
+
+    def on_cell_rehosted(self, cell_channel_id: int, new_owner) -> None:
+        """Failover hook (core/failover.py): the cell's authority moved
+        to ``new_owner``. What must stay exact is the placement ledger:
+        re-seed a row for every entity actually resident in the cell's
+        authoritative data (an entity shed/re-tracked during the outage
+        can have lost its row, and a later crossing orchestrated from
+        the wrong origin would leave its data duplicated across two
+        cells)."""
+        from ..core.channel import get_channel
+
+        ch = get_channel(cell_channel_id)
+        if ch is None:
+            return
+        entities = getattr(ch.get_data_message(), "entities", None)
+        if entities is None:
+            return
+        for eid in entities:
+            self._data_cell.setdefault(eid, cell_channel_id)
 
     def notify_crossings(self, crossings) -> None:
         """Batched migration: ``crossings`` is an iterable of
@@ -550,6 +635,7 @@ class StaticGrid2DSpatialController:
         crossing between one (src, dst) spatial channel pair."""
         from ..core.channel import get_channel
         from ..core.data import reflect_channel_data_message
+        from ..core.failover import journal as _journal
         from ..core.message import MessageContext
         from ..core.subscription import subscribe_to_channel
         from ..core.subscription_messages import send_subscribed, send_unsubscribed
@@ -572,6 +658,13 @@ class StaticGrid2DSpatialController:
         for provider in providers:
             handover_entity_id = provider(src_channel_id, dst_channel_id)
             if handover_entity_id is None:
+                continue
+            if _journal.remote_in_flight(handover_entity_id):
+                # Mid cross-gateway flight (a shard drain or a trunked
+                # crossing): the remote batch already captured the
+                # data. Commit removes the entity here; abort restores
+                # and re-offers it — orchestrating this local hop now
+                # would leave the data in two cells.
                 continue
             entity_channel = get_channel(handover_entity_id)
             if entity_channel is None:
@@ -598,6 +691,9 @@ class StaticGrid2DSpatialController:
             cell=str(dst_channel_id), direction="in"
         ).inc(contributing)
         _balancer.note_crossing(src_channel_id, dst_channel_id, contributing)
+        from ..federation.control import control as _global_control
+
+        _global_control.note_crossing(contributing)
 
         # Step 1: cross-server — swap entity-channel ownership first so the
         # src server's residual updates are ignored (prevents handover loops).

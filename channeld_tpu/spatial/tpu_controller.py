@@ -76,24 +76,17 @@ class TPUSpatialController(StaticGrid2DSpatialController):
         # alternate ticks at L2+.
         self._deferred_crossings: dict[int, tuple] = {}
         self._follow_skip = False
-        # entity id -> spatial channel id its DATA was last orchestrated
-        # into. The engine can re-detect a crossing (cells-plane re-offer
-        # after bucket overflow); without this ledger a stale duplicate
-        # detection merged into a deferred chain would orchestrate from
-        # the wrong cell and leave the entity's data in two channels.
-        self._data_cell: dict[int, int] = {}
+        # _data_cell: inherited — the placement ledger lives on the
+        # base grid controller (host gateways need the same exactness).
 
     def load_config(self, config: dict) -> None:
         super().load_config(config)
-        from ..core import events
         from ..ops.engine import SpatialEngine
         from ..ops.spatial_ops import GridSpec
 
-        def _on_channel_removed(channel_id: int) -> None:
-            if channel_id >= global_settings.entity_channel_id_start:
-                self.untrack_entity(channel_id)
-
-        events.channel_removed.listen_for(self, _on_channel_removed)
+        # channel_removed -> untrack_entity is registered by the base
+        # grid controller's load_config (polymorphic: the device-side
+        # cleanup in our untrack_entity override still runs).
 
         # Mesh selection: the controller Config's MeshDevices/MeshHosts keys
         # win over the -mesh-devices/-mesh-hosts flags. With a mesh, the
@@ -262,47 +255,19 @@ class TPUSpatialController(StaticGrid2DSpatialController):
             pass  # outside the world: no authoritative placement yet
         self._last_positions[entity_id] = info
 
-    def _note_entity_data_moved(self, entity_ids, dst_channel_id: int) -> None:
-        """Placement-ledger callback from _orchestrate_pair: fires only
-        when entity data ACTUALLY moved (a skipped orchestration —
-        missing channel, locked group — must leave the ledger on the
-        cell the data still lives in, or stale engine re-detections
-        would be mis-suppressed and the data stranded)."""
-        for eid in entity_ids:
-            self._data_cell[eid] = dst_channel_id
-
     def untrack_entity(self, entity_id: int) -> None:
         self.engine.remove_entity(entity_id)
         self._last_positions.pop(entity_id, None)
         self._prev_positions.pop(entity_id, None)
         self._providers.pop(entity_id, None)
         self._deferred_crossings.pop(entity_id, None)
-        self._data_cell.pop(entity_id, None)
-        # A destroyed entity's in-flight handover is moot — and so is a
-        # crossing parked behind a migration freeze (doc/balancer.md).
-        _journal.forget_entity(entity_id)
-        _balancer._frozen_crossings.pop(entity_id, None)
+        # Shared cleanup (placement ledger, journal, balancer freezes)
+        # lives on the base grid controller.
+        super().untrack_entity(entity_id)
 
-    def on_cell_rehosted(self, cell_channel_id: int, new_owner) -> None:
-        """Failover hook (core/failover.py): the cell's authority moved
-        to ``new_owner``. Nothing re-shards on device — the cells-plane
-        cell->shard placement is geometric, and the new owner's WRITE
-        subscription already registered a fresh engine fan-out slot.
-        What must stay exact is the placement ledger: re-seed a row for
-        every entity actually resident in the cell's authoritative data
-        (an entity shed/re-tracked during the outage can have lost its
-        row, and a later crossing orchestrated from the wrong origin
-        would leave its data duplicated across two cells)."""
-        from ..core.channel import get_channel
-
-        ch = get_channel(cell_channel_id)
-        if ch is None:
-            return
-        entities = getattr(ch.get_data_message(), "entities", None)
-        if entities is None:
-            return
-        for eid in entities:
-            self._data_cell.setdefault(eid, cell_channel_id)
+    # on_cell_rehosted / _note_entity_data_moved: inherited — the
+    # placement ledger lives on the base grid controller now (host
+    # gateways need the same exactness; doc/global_control.md).
 
     # ---- device fan-out plane --------------------------------------------
 
@@ -418,8 +383,7 @@ class TPUSpatialController(StaticGrid2DSpatialController):
         from ..spatial.messages import apply_interest_diff
 
         start = global_settings.spatial_channel_id_start
-        readbacks = 0
-        readback_ns = 0
+        live: list[int] = []
         for conn_id, entry in list(self._followers.items()):
             conn = entry["conn"]
             if conn.is_closing():
@@ -435,22 +399,29 @@ class TPUSpatialController(StaticGrid2DSpatialController):
                     entry["extent"], entry["direction"], entry["angle"],
                 )
                 entry["center"] = (info.x, info.z)
-            rb0 = _time.monotonic_ns()
-            desired = self.engine.interested_cells(result, conn_id)
-            readback_ns += _time.monotonic_ns() - rb0
-            readbacks += 1
+            live.append(conn_id)
+        if not live:
+            return
+        # ONE device->host transfer of the whole interest/dist tables for
+        # every follower (ROADMAP item 1: the per-follower row readback
+        # measured ~330us each — linear in followers, the single biggest
+        # live-gateway host cost); the per-follower diff runs on host
+        # slices. follower_readbacks now counts BATCHED transfers — one
+        # per pass, not one per follower.
+        rb0 = _time.monotonic_ns()
+        desired_all = self.engine.interested_cells_batch(result, live)
+        readback_ns = _time.monotonic_ns() - rb0
+        metrics.follower_readbacks.inc()
+        _trace.stage("readback", rb0, end_ns=rb0 + readback_ns)
+        for conn_id in live:
+            entry = self._followers.get(conn_id)
+            if entry is None:
+                continue
+            desired = desired_all.get(conn_id, {})
             apply_interest_diff(
-                conn, {start + cell: dist for cell, dist in desired.items()}
+                entry["conn"],
+                {start + cell: dist for cell, dist in desired.items()},
             )
-        if readbacks:
-            # ROADMAP item 1's bottleneck made live-visible: one
-            # device->host transfer PER follower today; the batched
-            # readback must drive this toward one per tick. The stage
-            # is the pass's aggregated transfer time (a synthetic
-            # contiguous span so the timeline shows its tick share).
-            metrics.follower_readbacks.inc(readbacks)
-            rb_end = _time.monotonic_ns()
-            _trace.stage("readback", rb_end - readback_ns, end_ns=rb_end)
 
     def tick(self) -> None:
         super().tick()  # reap closed server connections

@@ -52,10 +52,46 @@ SCHEMAS: dict[str, set] = {
     "BENCH_HANDOVER_*.json": {"metric", "crossings_per_tick",
                               "keeps_up_with_detection"},
     "BENCH_FANOUT_*.json": {"metric", "configs", "p99_under_5ms_all"},
+    "SOAK_GLOBAL_*.json": _SOAK_KEYS | {
+        "migration", "adoption", "redirect", "census",
+    },
     # Flight-recorder soak (doc/observability.md acceptance artifact).
     "TRACE_*.json": _SOAK_KEYS | {
         "stages", "anomaly_dumps", "cross_gateway", "overhead",
     },
+}
+
+
+def _check_global_soak(doc: dict) -> list[str]:
+    """The global-control soak's acceptance bar, pinned beyond key
+    presence: the invariant list must actually contain the migration /
+    exactly-one-survivor / ledger==metrics / redirect-resume checks
+    (doc/global_control.md), and the adoption census must be clean."""
+    errors: list[str] = []
+    names = {
+        c.get("name") for c in doc.get("invariants", {}).get("checks", [])
+    }
+    for required in (
+        "shard_migrations_committed",
+        "imbalance_flattened_below_enter",
+        "every_entity_on_exactly_one_survivor",
+        "redirect_resumed_on_adopter_without_reauth",
+    ):
+        if required not in names:
+            errors.append(f"missing invariant check {required!r}")
+    if not any(n and n.endswith("_ledger_matches_metric") for n in names):
+        errors.append("no ledger==metrics invariant checks")
+    census = doc.get("census", {})
+    if census.get("missing") or census.get("duplicated") \
+            or census.get("unexpected"):
+        errors.append(f"adoption census not clean: {census}")
+    if not doc.get("migration", {}).get("committed"):
+        errors.append("no committed cross-gateway shard migration")
+    return errors
+
+
+EXTRA_CHECKS = {
+    "SOAK_GLOBAL_*.json": _check_global_soak,
 }
 
 
@@ -83,6 +119,9 @@ def check_artifacts(repo: str = REPO) -> list[str]:
                     errors.append(
                         f"{name}: committed with failing invariants"
                     )
+            extra = EXTRA_CHECKS.get(pattern)
+            if extra is not None and not missing:
+                errors.extend(f"{name}: {e}" for e in extra(doc))
     # Nothing at the root may LOOK like a pinned artifact yet escape
     # every schema (a new SOAK_X_rNN.json must land with a schema row).
     for path in sorted(

@@ -194,10 +194,16 @@ async def _drain(reader, stop: asyncio.Event) -> None:
 
 
 async def boot_gateway(gw_id: str, fed_cfg: dict, params: FedSoakParams,
-                       stop: asyncio.Event):
+                       stop: asyncio.Event, world: dict = None,
+                       expect_cells: int = 8, settings_hook=None):
     """Fresh in-process gateway hosting ONE shard of the federated
     world: reset singletons, bring up listeners, master + one spatial
-    server (the local block), arm the federation plane."""
+    server (the local block), arm the federation plane.
+
+    ``world``/``expect_cells`` override the default 4x4 two-shard
+    geometry (scripts/global_soak.py boots a 3-shard world through this
+    same path); ``settings_hook(global_settings)`` runs last, after the
+    soak defaults — the global soak re-enables the control plane there."""
     from channeld_tpu.core import channel as channel_mod
     from channeld_tpu.core import connection as connection_mod
     from channeld_tpu.core import data as data_mod
@@ -249,6 +255,12 @@ async def boot_gateway(gw_id: str, fed_cfg: dict, params: FedSoakParams,
     # nondeterministic authority moves (L3 is driven explicitly in the
     # refusal phase instead).
     global_settings.balancer_enabled = False
+    # Global control plane pinned OFF (doc/global_control.md): its
+    # leader-planned shard migrations and death declarations would add
+    # nondeterministic authority moves to this soak's envelope
+    # (scripts/global_soak.py is the control plane's own soak, and
+    # re-enables it through settings_hook).
+    global_settings.global_control_enabled = False
     # Flight recorder pinned OFF (doc/observability.md): these soaks
     # prove deterministic accounting and timing envelopes; span
     # recording and anomaly auto-dumps must not perturb either
@@ -276,6 +288,9 @@ async def boot_gateway(gw_id: str, fed_cfg: dict, params: FedSoakParams,
             tick_interval_ms=50, default_fanout_interval_ms=100),
     }
 
+    if settings_hook is not None:
+        settings_hook(global_settings)
+
     register_sim_types()
     init_connections(
         os.path.join(REPO, "config", "server_authoritative_fsm.json"),
@@ -288,7 +303,7 @@ async def boot_gateway(gw_id: str, fed_cfg: dict, params: FedSoakParams,
         "/tmp", f"fed_soak_spatial_{gw_id}_{os.getpid()}.json"
     )
     with open(spatial_path, "w") as f:
-        json.dump(WORLD_SPATIAL, f)
+        json.dump(world if world is not None else WORLD_SPATIAL, f)
     init_spatial_controller(spatial_path)
     ctl = get_spatial_controller()
 
@@ -334,14 +349,16 @@ async def boot_gateway(gw_id: str, fed_cfg: dict, params: FedSoakParams,
     await s_writer.drain()
     tasks.append(asyncio.ensure_future(_drain(s_reader, stop)))
 
-    # Local shard up: 8 of the 16 cells exist here and are owned.
+    # Local shard up: this gateway's block of cells exists and is owned.
     start_id = global_settings.spatial_channel_id_start
     end_id = global_settings.entity_channel_id_start
     deadline = time.monotonic() + 20.0
     while time.monotonic() < deadline:
         cells = [ch for cid, ch in all_channels().items()
                  if start_id <= cid < end_id]
-        if len(cells) == 8 and all(ch.has_owner() for ch in cells):
+        if len(cells) == expect_cells and all(
+            ch.has_owner() for ch in cells
+        ):
             break
         await asyncio.sleep(0.05)
     else:
@@ -432,7 +449,7 @@ class FedSim:
                 self.entity_ids.append(cid)
 
     def create_entities(self, n: int, x0: float, x1: float,
-                        z0: float, z1: float) -> None:
+                        z0: float, z1: float, base: int = 0) -> None:
         from channeld_tpu.core.channel import create_entity_channel, get_channel
         from channeld_tpu.core.settings import global_settings
         from channeld_tpu.core.subscription import subscribe_to_channel
@@ -441,7 +458,7 @@ class FedSim:
 
         estart = global_settings.entity_channel_id_start
         for i in range(n):
-            eid = estart + 1 + i
+            eid = estart + 1 + base + i
             x = self.rng.uniform(x0, x1)
             z = self.rng.uniform(z0, z1)
             cell_ch = get_channel(

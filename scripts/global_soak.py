@@ -1,0 +1,895 @@
+"""Global-control soak: 3 gateways, shard rebalancing + death failover.
+
+The acceptance proof for the global control plane
+(channeld_tpu/federation/control.py, doc/global_control.md). Three REAL
+gateway processes — this one in-process (gateway "a", the lowest id and
+therefore the deterministic leader) plus two ``--role remote`` children
+("b", "c") — share a 6x4 world split into three 2x4 shard blocks,
+fully trunk-meshed, with the control plane armed:
+
+1. **boot** — all three gateways bring up their shards, the trunk mesh
+   handshakes, control epochs start (load vectors + shard replication),
+   and a small even population spawns on every gateway.
+2. **hotspot flatten** — a crowd spawns across gateway "b"'s cells,
+   driving the fleet max/mean imbalance over the enter threshold. The
+   leader ("a") must plan >= 1 per-cell shard migration off "b" through
+   the trunked transactional handover and flatten the fold back under
+   the threshold — territory moves between LIVE gateways, zero loss.
+3. **redirect staging** — a client on "a" anchors on an entity that is
+   herded into "c"'s shard; the client receives its ClientRedirectMessage
+   (the staged recovery handle lands on "c") but deliberately does NOT
+   follow it yet.
+4. **SIGKILL mid-burst** — a herd into "c"'s shard starts and "c" is
+   SIGKILLed while trunk handover batches are in flight. The leader
+   declares "c" dead after the miss threshold, re-maps its cells via
+   directory overrides, and the least-loaded survivor adopts the shard
+   from its epoch replica: in-flight batches toward "c" abort back to
+   their sources, replicated in-flight journal records replay
+   source-wins, committed-but-unreplicated batches resurrect on their
+   initiators, and the replicated recovery handles re-stage.
+5. **resume + census** — the redirect client now connects (its redirect
+   target is DEAD) to the adopter and must resume through the
+   replicated staged handle without re-auth. Traffic stops, everything
+   drains, both survivors report.
+
+The invariant checker asserts the PR's acceptance bar: >= 1 committed
+cross-gateway shard migration with the imbalance flattened below the
+enter threshold; the killed gateway's shard adopted with **zero
+entities lost or duplicated across the federation**; python ledgers ==
+``global_migrations_total{result}`` / ``gateway_adoptions_total`` on
+every survivor; the redirected client resumed on the adopter without
+re-auth.
+
+Run the acceptance soak (~60s of timeline):
+  python scripts/global_soak.py --out SOAK_GLOBAL_r12.json
+
+The <60s CI smoke runs the same machinery with smaller numbers
+(tests/test_global_control.py::test_global_smoke_soak).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPTS = os.path.dirname(os.path.abspath(__file__))
+for p in (REPO, SCRIPTS):
+    if p not in sys.path:
+        sys.path.insert(0, p)
+
+import argparse
+import asyncio
+import json
+import signal
+import subprocess
+import time
+from dataclasses import dataclass
+from random import Random
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from federation_soak import (  # noqa: E402
+    Child,
+    FedSim,
+    _auth_frame,
+    _connect,
+    _free_ports,
+    boot_gateway,
+    local_placement,
+    teardown_gateway,
+)
+
+# 6x4 world, three 2x4 shard blocks: a = cols 0-1 (x in [-150,-50)),
+# b = cols 2-3 ([-50,50)), c = cols 4-5 ([50,150)).
+WORLD_3 = {
+    "SpatialControllerType": "Static2DSpatialController",
+    "Config": {
+        "WorldOffsetX": -150,
+        "WorldOffsetZ": -100,
+        "GridWidth": 50,
+        "GridHeight": 50,
+        "GridCols": 6,
+        "GridRows": 4,
+        "ServerCols": 3,
+        "ServerRows": 1,
+        "ServerInterestBorderSize": 0,
+    },
+}
+
+# Per-gateway x ranges (strictly inside each shard).
+XR = {"a": (-148.0, -52.0), "b": (-48.0, 48.0), "c": (52.0, 148.0)}
+ZR = (-98.0, 98.0)
+# Deterministic entity-id bases so the parent can census every id.
+BASE = {"a": 0, "b": 1000, "c": 2000}
+
+
+@dataclass
+class GlobalSoakParams:
+    seed: int = 20260803
+    base_entities: int = 10      # per gateway at boot
+    hotspot: int = 36            # extra entities spawned across b
+    kill_burst: int = 10         # a->c herd in flight at the SIGKILL
+    committed_to_c: int = 4      # a->c handovers committed pre-kill
+    epoch_ms: int = 250
+    heartbeat_ms: int = 150
+    trunk_timeout_ms: int = 900
+    handover_timeout_ms: int = 1500
+    death_miss_epochs: int = 4
+    imbalance_enter: float = 1.25
+    phase_timeout_s: float = 25.0
+    quiesce_s: float = 2.0
+    child_boot_timeout_s: float = 60.0
+    global_tick_ms: int = 20
+    out_path: str = ""
+
+
+def _fed_config3(ports: dict) -> dict:
+    return {
+        "secret": "global-soak-secret",
+        "gateways": {
+            gw: {
+                "trunk": f"127.0.0.1:{ports[gw + '_trunk']}",
+                "client": f"127.0.0.1:{ports[gw + '_client']}",
+                "servers": [i],
+            }
+            for i, gw in enumerate(("a", "b", "c"))
+        },
+    }
+
+
+def _settings_hook(p: GlobalSoakParams):
+    def hook(gs) -> None:
+        gs.global_control_enabled = True
+        gs.global_epoch_ms = p.epoch_ms
+        gs.global_imbalance_enter = p.imbalance_enter
+        gs.global_imbalance_exit = p.imbalance_enter * 0.85
+        gs.global_hold_epochs = 2
+        gs.global_min_entity_delta = 8
+        gs.global_death_miss_epochs = p.death_miss_epochs
+        gs.global_budget_per_window = 8
+        gs.global_budget_window_epochs = 120
+        gs.global_cooldown_epochs = 8
+        gs.global_migrate_timeout_ms = 8000
+        gs.global_adopt_claims_timeout_ms = 800
+        gs.failover_enabled = True
+
+    return hook
+
+
+async def boot3(gw_id: str, fed_cfg: dict, p: GlobalSoakParams,
+                stop: asyncio.Event):
+    from federation_soak import FedSoakParams
+
+    fp = FedSoakParams(
+        heartbeat_ms=p.heartbeat_ms,
+        trunk_timeout_ms=p.trunk_timeout_ms,
+        handover_timeout_ms=p.handover_timeout_ms,
+        global_tick_ms=p.global_tick_ms,
+    )
+    return await boot_gateway(
+        gw_id, fed_cfg, fp, stop, world=WORLD_3, expect_cells=8,
+        settings_hook=_settings_hook(p),
+    )
+
+
+def control_report(baseline: dict) -> dict:
+    """The control plane's soak-facing report + its metric double-entry
+    (global_migrations_total{result}, gateway_adoptions_total,
+    gateway_deaths_total deltas from the in-process registry)."""
+    from channeld_tpu.chaos.invariants import delta, sample_total, scrape
+    from channeld_tpu.federation.control import control
+
+    d = delta(scrape(), baseline)
+    migrations: dict[str, int] = {}
+    for (name, labels), value in d.items():
+        if name == "global_migrations_total" and value:
+            migrations[dict(labels)["result"]] = int(value)
+    rep = control.report()
+    rep["metric_migrations"] = migrations
+    rep["metric_adoptions"] = int(sample_total(d, "gateway_adoptions_total"))
+    rep["metric_deaths"] = int(sample_total(d, "gateway_deaths_total"))
+    return rep
+
+
+# ---------------------------------------------------------------------------
+# remote role (gateways "b"/"c"): child processes driven over stdin
+# ---------------------------------------------------------------------------
+
+
+async def remote_main(args) -> None:
+    from channeld_tpu.chaos.invariants import scrape
+    from channeld_tpu.core.failover import journal
+
+    with open(args.config) as f:
+        fed_cfg = json.load(f)
+    p = GlobalSoakParams(
+        heartbeat_ms=args.heartbeat_ms,
+        trunk_timeout_ms=args.trunk_timeout_ms,
+        handover_timeout_ms=args.handover_timeout_ms,
+        epoch_ms=args.epoch_ms,
+        death_miss_epochs=args.death_miss_epochs,
+        imbalance_enter=args.imbalance_enter,
+    )
+    stop = asyncio.Event()
+    gw = await boot3(args.gw_id, fed_cfg, p, stop)
+    plane = gw["plane"]
+    ctl = gw["ctl"]
+    rng = Random(args.seed ^ ord(args.gw_id))
+    sim = FedSim(ctl, rng)
+    baseline = scrape()
+    print("READY", flush=True)
+
+    x0, x1 = XR[args.gw_id]
+
+    async def _jitter_loop():
+        while not stop.is_set():
+            sim.adopt_scan()
+            if sim.local_ids():
+                sim.jitter(x0, x1, ZR[0], ZR[1])
+            await asyncio.sleep(0.2)
+
+    jitter_task = asyncio.ensure_future(_jitter_loop())
+
+    loop = asyncio.get_running_loop()
+    reader = asyncio.StreamReader()
+    await loop.connect_read_pipe(
+        lambda: asyncio.StreamReaderProtocol(reader), sys.stdin
+    )
+    while True:
+        line = await reader.readline()
+        if not line:
+            break
+        try:
+            cmd = json.loads(line)
+        except ValueError:
+            continue
+        name = cmd.get("cmd")
+        if name == "spawn":
+            sim.create_entities(
+                int(cmd["n"]), x0, x1, ZR[0], ZR[1],
+                base=BASE[args.gw_id] + int(cmd.get("offset", 0)),
+            )
+            print(f"OK spawn {cmd['n']}", flush=True)
+        elif name == "herd_to":
+            sim.adopt_scan()
+            tx0, tx1 = XR[cmd["gw"]]
+            ids = sim.local_ids()[: int(cmd.get("n", 8))]
+            moved = sim.herd(ids, tx0, tx1, ZR[0], ZR[1])
+            print(f"OK herd_to {len(moved)}", flush=True)
+        elif name == "quiesce":
+            jitter_task.cancel()
+            deadline = time.monotonic() + float(cmd.get("drain_s", 10.0))
+            while time.monotonic() < deadline and (
+                plane._pending or plane._parked
+                or journal.in_flight_count()
+            ):
+                await asyncio.sleep(0.1)
+            print("OK quiesce", flush=True)
+        elif name == "report":
+            placement = local_placement()
+            report = {
+                "gateway": args.gw_id,
+                "ledger": dict(plane.ledger),
+                "control": control_report(baseline),
+                "placement": placement,
+                "forensics": entity_forensics(
+                    [int(e) for e in placement if not e.startswith("__")]
+                ),
+                "pending": len(plane._pending),
+                "parked": len(plane._parked),
+                "journal": journal.report(),
+                "events": plane.events[-400:],
+            }
+            with open(args.report, "w") as f:
+                json.dump(report, f)
+            print("OK report", flush=True)
+        elif name == "exit":
+            break
+    stop.set()
+    jitter_task.cancel()
+    teardown_gateway(gw)
+
+
+# ---------------------------------------------------------------------------
+# the delayed-resume redirect client
+# ---------------------------------------------------------------------------
+
+
+async def wait_redirect(host: str, port: int, pit: str, result: dict,
+                        stop: asyncio.Event) -> None:
+    """Connect to gateway a, record the ClientRedirectMessage — and stop
+    there (the soak kills the redirect target before the client moves)."""
+    from channeld_tpu.core.types import MessageType
+    from channeld_tpu.protocol import FrameDecoder, control_pb2
+
+    from federation_soak import _auth_and_wait
+
+    reader, writer = await _connect(host, port)
+    await _auth_and_wait(reader, writer, pit)
+    result["authed_a"] = True
+    dec = FrameDecoder()
+    while "redirect" not in result and not stop.is_set():
+        try:
+            data = await asyncio.wait_for(reader.read(65536), timeout=0.5)
+        except asyncio.TimeoutError:
+            continue
+        except (ConnectionError, OSError):
+            break
+        if not data:
+            break
+        for packet in dec.decode_packets(data):
+            for mp in packet.messages:
+                if mp.msgType == MessageType.CLIENT_REDIRECT:
+                    rd = control_pb2.ClientRedirectMessage()
+                    rd.ParseFromString(mp.msgBody)
+                    result["redirect"] = {
+                        "gateway": rd.gatewayId, "addr": rd.addr,
+                        "entity": rd.entityId, "channel": rd.channelId,
+                    }
+    try:
+        writer.close()
+    except Exception:
+        pass
+
+
+async def resume_on(host: str, port: int, pit: str, result: dict) -> None:
+    """Dial a survivor with the same PIT; record whether the session
+    resumed through recovery (no fresh-login round trips)."""
+    from channeld_tpu.core.types import MessageType
+    from channeld_tpu.protocol import FrameDecoder, control_pb2
+
+    reader, writer = await _connect(host, port)
+    writer.write(_auth_frame(pit))
+    await writer.drain()
+    dec = FrameDecoder()
+    deadline = time.monotonic() + 10.0
+    recovery_channels = []
+    while time.monotonic() < deadline:
+        try:
+            data = await asyncio.wait_for(reader.read(65536), timeout=1.0)
+        except asyncio.TimeoutError:
+            continue
+        except (ConnectionError, OSError):
+            break
+        if not data:
+            break
+        done = False
+        for packet in dec.decode_packets(data):
+            for mp in packet.messages:
+                if mp.msgType == MessageType.AUTH:
+                    ar = control_pb2.AuthResultMessage()
+                    ar.ParseFromString(mp.msgBody)
+                    result["auth_result"] = int(ar.result)
+                    result["should_recover"] = bool(ar.shouldRecover)
+                elif mp.msgType == MessageType.RECOVERY_CHANNEL_DATA:
+                    rm = control_pb2.ChannelDataRecoveryMessage()
+                    rm.ParseFromString(mp.msgBody)
+                    recovery_channels.append(rm.channelId)
+                elif mp.msgType == MessageType.RECOVERY_END:
+                    result["recovery_end"] = True
+                    done = True
+        if done:
+            break
+    result["recovery_channels"] = recovery_channels
+    try:
+        writer.close()
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# the soak
+# ---------------------------------------------------------------------------
+
+
+def _spawn_child(gw_id: str, cfg_path: str, report_path: str,
+                 p: GlobalSoakParams) -> subprocess.Popen:
+    # Child gateway logs land next to the report (post-mortem material:
+    # the SIGKILLed gateway's last lines tell what was in flight).
+    errlog = open(f"{report_path}.{gw_id}.log", "w")
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--role", "remote",
+         "--gw-id", gw_id, "--config", cfg_path, "--report", report_path,
+         "--seed", str(p.seed),
+         "--epoch-ms", str(p.epoch_ms),
+         "--heartbeat-ms", str(p.heartbeat_ms),
+         "--trunk-timeout-ms", str(p.trunk_timeout_ms),
+         "--handover-timeout-ms", str(p.handover_timeout_ms),
+         "--death-miss-epochs", str(p.death_miss_epochs),
+         "--imbalance-enter", str(p.imbalance_enter)],
+        cwd=REPO, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=errlog, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+
+
+async def run_global_soak(p: GlobalSoakParams) -> dict:
+    from channeld_tpu.chaos.invariants import InvariantChecker, scrape
+    from channeld_tpu.core.connection import all_connections
+    from channeld_tpu.core.failover import journal
+    from channeld_tpu.federation.control import control
+
+    t_start = time.monotonic()
+    ports = dict(zip(
+        ("a_trunk", "a_client", "b_trunk", "b_client", "c_trunk",
+         "c_client"), _free_ports(6),
+    ))
+    fed_cfg = _fed_config3(ports)
+    pid = os.getpid()
+    cfg_path = f"/tmp/global_soak_cfg_{pid}.json"
+    b_report_path = f"/tmp/global_soak_b_{pid}.json"
+    c_report_path = f"/tmp/global_soak_c_{pid}.json"
+    with open(cfg_path, "w") as f:
+        json.dump(fed_cfg, f)
+
+    b_proc = _spawn_child("b", cfg_path, b_report_path, p)
+    c_proc = _spawn_child("c", cfg_path, c_report_path, p)
+    b, c = Child(b_proc), Child(c_proc)
+
+    stop = asyncio.Event()
+    gw = None
+    timeline: list[dict] = []
+    notes: list[str] = []
+
+    def mark(phase: str, **kw) -> None:
+        timeline.append({
+            "t": round(time.monotonic() - t_start, 2), "phase": phase, **kw
+        })
+
+    try:
+        await b.wait_for("READY", p.child_boot_timeout_s)
+        await c.wait_for("READY", p.child_boot_timeout_s)
+        gw = await boot3("a", fed_cfg, p, stop)
+        plane = gw["plane"]
+        ctl = gw["ctl"]
+        baseline = scrape()
+
+        # Full trunk mesh up from a's perspective.
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline and (
+            plane.link_to("b") is None or plane.link_to("c") is None
+        ):
+            await asyncio.sleep(0.05)
+        if plane.link_to("b") is None or plane.link_to("c") is None:
+            raise RuntimeError("trunk mesh never came up")
+        mark("trunk_mesh_up", leader=control.leader())
+
+        rng = Random(p.seed ^ 0xA)
+        sim = FedSim(ctl, rng)
+        sim.create_entities(p.base_entities, *XR["a"], *ZR, base=BASE["a"])
+        await b.cmd("spawn", n=p.base_entities)
+        await c.cmd("spawn", n=p.base_entities)
+        expected_ids = set()
+        estart = 0x00080000
+        for gw_id in ("a", "b", "c"):
+            expected_ids |= {
+                str(estart + 1 + BASE[gw_id] + i)
+                for i in range(p.base_entities)
+            }
+        # Control epochs need a few rounds to see everyone's vectors +
+        # replicas before anything interesting happens.
+        await asyncio.sleep(p.epoch_ms * 4 / 1000.0)
+        mark("boot", entities=len(expected_ids))
+
+        # ---- phase 1: hotspot on b -> leader flattens it ----
+        await b.cmd("spawn", n=p.hotspot, offset=100)
+        expected_ids |= {
+            str(estart + 1 + BASE["b"] + 100 + i) for i in range(p.hotspot)
+        }
+
+        async def wait_migration(at_least: int, timeout: float) -> bool:
+            end = time.monotonic() + timeout
+            while time.monotonic() < end:
+                if control.ledger.get("committed", 0) >= at_least:
+                    return True
+                await asyncio.sleep(0.1)
+            return False
+
+        ok = await wait_migration(1, p.phase_timeout_s)
+        if not ok:
+            notes.append(
+                f"no committed shard migration: ledger={control.ledger} "
+                f"imbalance={control.imbalance}"
+            )
+        # Let the fold settle and (budget allowing) further plans land.
+        fdeadline = time.monotonic() + p.phase_timeout_s
+        while time.monotonic() < fdeadline and (
+            control.imbalance >= p.imbalance_enter
+            or control._plans or control._drain is not None
+        ):
+            await asyncio.sleep(0.2)
+        committed_migrations = control.ledger.get("committed", 0)
+        flattened_imbalance = control.imbalance
+        mark("hotspot_flattened",
+             committed=committed_migrations,
+             imbalance=round(flattened_imbalance, 3),
+             ledger=dict(control.ledger))
+
+        # ---- phase 2: commit a->c handovers (resurrection material),
+        # anchor a client on an entity herded into c ----
+        local_a = [e for e in sim.local_ids()
+                   if e < estart + 1 + BASE["b"]]
+        committed_before = plane.ledger.get("committed", 0)
+        herd_ids = local_a[: p.committed_to_c]
+        anchor_eid = herd_ids[0]
+
+        redirect_result: dict = {}
+        client_task = asyncio.ensure_future(wait_redirect(
+            "127.0.0.1", gw["client_port"], "global-client-0",
+            redirect_result, stop,
+        ))
+        cdeadline = time.monotonic() + 10.0
+        anchor_conn = None
+        while time.monotonic() < cdeadline and anchor_conn is None:
+            for conn in all_connections().values():
+                if getattr(conn, "pit", "") == "global-client-0" \
+                        and not conn.is_closing():
+                    anchor_conn = conn
+                    break
+            await asyncio.sleep(0.05)
+        if anchor_conn is None:
+            raise RuntimeError("anchored client never authed")
+        plane.set_client_anchor(anchor_conn, anchor_eid)
+
+        sim.herd(herd_ids, *XR["c"], *ZR)
+        hdeadline = time.monotonic() + p.phase_timeout_s
+        while time.monotonic() < hdeadline and (
+            plane.ledger.get("committed", 0)
+            < committed_before + len(herd_ids)
+        ):
+            await asyncio.sleep(0.1)
+        rdeadline = time.monotonic() + p.phase_timeout_s
+        while time.monotonic() < rdeadline \
+                and "redirect" not in redirect_result:
+            await asyncio.sleep(0.1)
+        if "redirect" not in redirect_result:
+            notes.append(f"redirect never arrived: {redirect_result}")
+        # >= 1 control epoch so c's replica (incl. the staged handle and
+        # the committed entities) reaches the survivors.
+        await asyncio.sleep(p.epoch_ms * 3 / 1000.0)
+        mark("committed_into_c",
+             committed=plane.ledger.get("committed", 0) - committed_before,
+             redirect=redirect_result.get("redirect"))
+
+        # ---- phase 3: SIGKILL c mid-handover-burst ----
+        sim.adopt_scan()
+        local_a = [e for e in sim.local_ids() if get_alive(e)]
+        burst_ids = local_a[: p.kill_burst]
+        sim.herd(burst_ids, *XR["c"], *ZR)
+        kdeadline = time.monotonic() + 5.0
+        killed_mid_burst = False
+        while time.monotonic() < kdeadline:
+            if any(bt.peer == "c" for bt in plane._pending.values()):
+                c_proc.send_signal(signal.SIGKILL)
+                killed_mid_burst = True
+                break
+            await asyncio.sleep(0)
+        if not killed_mid_burst:
+            c_proc.send_signal(signal.SIGKILL)
+            notes.append("kill raced: no batch toward c in flight at kill")
+        mark("sigkill_c", mid_burst=killed_mid_burst)
+
+        # Death declaration + adoption.
+        adeadline = time.monotonic() + p.phase_timeout_s * 2
+        while time.monotonic() < adeadline and "c" not in control.dead:
+            await asyncio.sleep(0.1)
+        if "c" not in control.dead:
+            raise RuntimeError(
+                f"c never declared dead: report={control.report()}"
+            )
+        adopter = None
+        adeadline = time.monotonic() + p.phase_timeout_s
+        while time.monotonic() < adeadline and adopter is None:
+            for ev in control.events:
+                if ev.get("kind") == "gateway_dead" and ev["dead"] == "c":
+                    adopter = ev["adopter"]
+                    break
+            await asyncio.sleep(0.1)
+        if adopter is None:
+            raise RuntimeError("no adoption assignment observed")
+        # Wait until the adoption actually ran (locally or on b).
+        if adopter == "a":
+            wdeadline = time.monotonic() + p.phase_timeout_s
+            while time.monotonic() < wdeadline and control.adoptions < 1:
+                await asyncio.sleep(0.1)
+        else:
+            await asyncio.sleep(p.epoch_ms * 6 / 1000.0)
+        mark("adopted", adopter=adopter, deaths=control.deaths)
+
+        # ---- phase 4: the redirect client resumes on a survivor ----
+        resume_result: dict = {}
+        if redirect_result.get("redirect"):
+            # The redirect target (c) is dead: a well-behaved client
+            # falls back to the surviving gateways in directory order.
+            for target in ("a", "b"):
+                port = int(fed_cfg["gateways"][target]["client"]
+                           .rpartition(":")[2])
+                try:
+                    await resume_on("127.0.0.1", port, "global-client-0",
+                                    resume_result)
+                except (ConnectionError, OSError, TimeoutError) as e:
+                    resume_result.setdefault("errors", []).append(
+                        f"{target}: {e}"
+                    )
+                    continue
+                if resume_result.get("should_recover"):
+                    resume_result["resumed_on"] = target
+                    break
+        mark("client_resumed", **{
+            k: v for k, v in resume_result.items()
+            if k != "recovery_channels"
+        })
+
+        # ---- quiesce + census across the survivors ----
+        await b.cmd("quiesce", timeout=p.phase_timeout_s + 5.0,
+                    drain_s=p.phase_timeout_s)
+        qdeadline = time.monotonic() + p.phase_timeout_s
+        while time.monotonic() < qdeadline and (
+            plane._pending or plane._parked or journal.in_flight_count()
+        ):
+            await asyncio.sleep(0.1)
+        await asyncio.sleep(p.quiesce_s)
+        await b.cmd("report", timeout=15.0)
+        with open(b_report_path) as f:
+            b_report = json.load(f)
+
+        a_placement = local_placement()
+        b_placement = dict(b_report["placement"])
+        local_dups_a = a_placement.pop("__local_dups__", [])
+        local_dups_b = b_placement.pop("__local_dups__", [])
+        a_control = control_report(baseline)
+
+        inv = InvariantChecker()
+
+        # (a) >= 1 committed cross-gateway shard migration, and the
+        #     fold flattened below the enter threshold.
+        inv.expect_gt("shard_migrations_committed",
+                      committed_migrations, 0)
+        inv.check(
+            "imbalance_flattened_below_enter",
+            flattened_imbalance < p.imbalance_enter,
+            f"imbalance={flattened_imbalance} enter={p.imbalance_enter}",
+        )
+
+        # (b) c's shard adopted; zero entities lost or duplicated.
+        inv.check("c_declared_dead", "c" in control.dead, "")
+        inv.expect_gt(
+            "shard_adopted",
+            a_control["adoptions"]
+            + b_report["control"]["adoptions"], 0,
+        )
+        counts: dict[str, list] = {}
+        for eid, cell in a_placement.items():
+            counts.setdefault(eid, []).append(("a", cell))
+        for eid, cell in b_placement.items():
+            counts.setdefault(eid, []).append(("b", cell))
+        missing = sorted(e for e in expected_ids if e not in counts)
+        duplicated = {e: w for e, w in counts.items() if len(w) > 1}
+        unexpected = sorted(e for e in counts if e not in expected_ids)
+        inv.expect_equal(
+            "every_entity_on_exactly_one_survivor",
+            (missing, duplicated, unexpected, local_dups_a, local_dups_b),
+            ([], {}, [], [], []),
+        )
+
+        # Ledgers == metrics on every survivor.
+        inv.expect_equal("a_migrations_ledger_matches_metric",
+                         a_control["metric_migrations"],
+                         a_control["ledger"])
+        inv.expect_equal("b_migrations_ledger_matches_metric",
+                         b_report["control"]["metric_migrations"],
+                         b_report["control"]["ledger"])
+        inv.expect_equal("a_adoptions_ledger_matches_metric",
+                         a_control["metric_adoptions"],
+                         a_control["adoptions"])
+        inv.expect_equal("b_adoptions_ledger_matches_metric",
+                         b_report["control"]["metric_adoptions"],
+                         b_report["control"]["adoptions"])
+        inv.expect_equal("a_deaths_ledger_matches_metric",
+                         a_control["metric_deaths"],
+                         a_control["deaths"])
+        inv.expect_equal("b_deaths_ledger_matches_metric",
+                         b_report["control"]["metric_deaths"],
+                         b_report["control"]["deaths"])
+
+        # (c) the redirected client resumed on a survivor, no re-auth.
+        inv.check("client_redirect_received",
+                  bool(redirect_result.get("redirect")),
+                  str(redirect_result))
+        inv.check(
+            "redirect_resumed_on_adopter_without_reauth",
+            resume_result.get("should_recover", False)
+            and resume_result.get("auth_result", -1) == 0
+            and resume_result.get("recovery_end", False),
+            str(resume_result),
+        )
+
+        # Nothing left in flight anywhere.
+        inv.expect_equal(
+            "nothing_left_in_flight",
+            (len(plane._pending), len(plane._parked),
+             b_report["pending"], b_report["parked"],
+             journal.in_flight_count()),
+            (0, 0, 0, 0, 0),
+        )
+
+        report = {
+            "kind": "global_soak",
+            "duration_s": round(time.monotonic() - t_start, 2),
+            "entities": len(expected_ids),
+            "knobs": {
+                "epoch_ms": p.epoch_ms,
+                "death_miss_epochs": p.death_miss_epochs,
+                "imbalance_enter": p.imbalance_enter,
+                "trunk_timeout_ms": p.trunk_timeout_ms,
+            },
+            "directory": fed_cfg,
+            "timeline": timeline,
+            "migration": {
+                "committed": committed_migrations,
+                "imbalance_after": round(flattened_imbalance, 4),
+                "leader_ledger": dict(control.ledger),
+            },
+            "adoption": {
+                "dead": "c",
+                "adopter": adopter,
+                "killed_mid_burst": killed_mid_burst,
+                "a": {
+                    k: a_control[k]
+                    for k in ("adoptions", "deaths", "counters")
+                },
+                "b": {
+                    k: b_report["control"][k]
+                    for k in ("adoptions", "deaths", "counters")
+                },
+            },
+            "redirect": {
+                "issued": redirect_result.get("redirect"),
+                "resume": {
+                    k: v for k, v in resume_result.items()
+                    if k != "recovery_channels"
+                },
+            },
+            "gateways": {
+                "a": {
+                    "ledger": dict(plane.ledger),
+                    "control": a_control,
+                    "journal": journal.report(),
+                    "events": plane.events[-400:],
+                },
+                "b": {k: v for k, v in b_report.items()
+                      if k != "placement"},
+            },
+            "census": {
+                "expected": len(expected_ids),
+                "on_a": len(a_placement),
+                "on_b": len(b_placement),
+                "missing": missing,
+                "duplicated": {str(k): v for k, v in duplicated.items()},
+                "unexpected": unexpected,
+                "forensics": {
+                    "a": entity_forensics(
+                        [int(e) for e in list(duplicated) + missing]
+                    ),
+                    "b": {
+                        str(e): b_report.get("forensics", {}).get(str(e))
+                        for e in list(duplicated) + missing
+                    },
+                },
+            },
+            "invariants": inv.summary(),
+        }
+        if notes:
+            report["notes"] = notes
+        if p.out_path:
+            with open(p.out_path, "w") as f:
+                json.dump(report, f, indent=2)
+        stop.set()
+        client_task.cancel()
+        return report
+    finally:
+        stop.set()
+        for proc in (b_proc, c_proc):
+            try:
+                if proc.poll() is None:
+                    try:
+                        proc.stdin.write('{"cmd": "exit"}\n')
+                        proc.stdin.flush()
+                    except (BrokenPipeError, OSError):
+                        pass
+                    try:
+                        proc.wait(timeout=8)
+                    except subprocess.TimeoutExpired:
+                        proc.kill()
+            except Exception:
+                pass
+        if gw is not None:
+            teardown_gateway(gw)
+        for path in (cfg_path, b_report_path, c_report_path):
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+
+def get_alive(eid: int) -> bool:
+    from channeld_tpu.core.channel import get_channel
+
+    ch = get_channel(eid)
+    return ch is not None and not ch.is_removing()
+
+
+def entity_forensics(eids) -> dict:
+    """Post-mortem detail for suspicious entity ids on THIS gateway:
+    does an entity channel exist, what does the placement ledger say,
+    and which local cells' data actually hold a row — separates a live
+    double from channel-less data residue in a failed census."""
+    from channeld_tpu.core.channel import all_channels, get_channel
+    from channeld_tpu.core.settings import global_settings
+    from channeld_tpu.spatial.controller import get_spatial_controller
+
+    ledger = getattr(get_spatial_controller(), "_data_cell", {})
+    lo = global_settings.spatial_channel_id_start
+    hi = global_settings.entity_channel_id_start
+    out: dict = {}
+    for eid in eids:
+        rows = []
+        for cid, ch in all_channels().items():
+            if lo <= cid < hi and not ch.is_removing():
+                ents = getattr(ch.get_data_message(), "entities", None)
+                if ents is not None and eid in ents:
+                    rows.append(cid)
+        out[str(eid)] = {
+            "channel": get_alive(eid),
+            "ledger": ledger.get(eid),
+            "rows": rows,
+        }
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--role", choices=("soak", "remote"), default="soak")
+    ap.add_argument("--gw-id", type=str, default="b")
+    ap.add_argument("--config", type=str, default="")
+    ap.add_argument("--report", type=str, default="")
+    ap.add_argument("--seed", type=int, default=20260803)
+    ap.add_argument("--base-entities", type=int, default=10)
+    ap.add_argument("--hotspot", type=int, default=36)
+    ap.add_argument("--kill-burst", type=int, default=10)
+    ap.add_argument("--committed-to-c", type=int, default=4)
+    ap.add_argument("--epoch-ms", type=int, default=250)
+    ap.add_argument("--heartbeat-ms", type=int, default=150)
+    ap.add_argument("--trunk-timeout-ms", type=int, default=900)
+    ap.add_argument("--handover-timeout-ms", type=int, default=1500)
+    ap.add_argument("--death-miss-epochs", type=int, default=4)
+    ap.add_argument("--imbalance-enter", type=float, default=1.25)
+    ap.add_argument("--out", type=str, default="")
+    args = ap.parse_args()
+    if args.role == "remote":
+        asyncio.run(remote_main(args))
+        return
+    p = GlobalSoakParams(
+        seed=args.seed, base_entities=args.base_entities,
+        hotspot=args.hotspot, kill_burst=args.kill_burst,
+        committed_to_c=args.committed_to_c, epoch_ms=args.epoch_ms,
+        heartbeat_ms=args.heartbeat_ms,
+        trunk_timeout_ms=args.trunk_timeout_ms,
+        handover_timeout_ms=args.handover_timeout_ms,
+        death_miss_epochs=args.death_miss_epochs,
+        imbalance_enter=args.imbalance_enter, out_path=args.out,
+    )
+    report = asyncio.run(run_global_soak(p))
+    slim = dict(report)
+    slim["gateways"] = {
+        g: {k: v for k, v in r.items() if k != "events"}
+        for g, r in report["gateways"].items()
+    }
+    print(json.dumps(slim, indent=2))
+    if not report["invariants"]["ok"]:
+        raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()

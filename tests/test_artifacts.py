@@ -40,3 +40,42 @@ def test_failing_invariants_artifact_is_flagged(tmp_path):
     }))
     errors = check_artifacts.check_artifacts(str(tmp_path))
     assert any("failing invariants" in e for e in errors)
+
+
+def test_global_soak_dirty_census_is_flagged(tmp_path):
+    """The SOAK_GLOBAL extra checks actually check: key-complete
+    artifacts with a dirty adoption census (or no committed migration)
+    are flagged even when invariants claim ok."""
+    import json
+
+    doc = {
+        "kind": "global_soak",
+        "invariants": {"ok": True, "checks": [
+            {"name": n, "ok": True} for n in (
+                "shard_migrations_committed",
+                "imbalance_flattened_below_enter",
+                "every_entity_on_exactly_one_survivor",
+                "a_migrations_ledger_matches_metric",
+                "redirect_resumed_on_adopter_without_reauth",
+            )
+        ]},
+        "migration": {"committed": 1},
+        "adoption": {}, "redirect": {}, "timeline": [],
+        "census": {"missing": [], "duplicated": {"9": 2},
+                   "unexpected": []},
+    }
+    (tmp_path / "SOAK_GLOBAL_r99.json").write_text(json.dumps(doc))
+    errors = check_artifacts.check_artifacts(str(tmp_path))
+    assert any("census not clean" in e for e in errors)
+
+    doc["census"]["duplicated"] = {}
+    doc["migration"]["committed"] = 0
+    (tmp_path / "SOAK_GLOBAL_r99.json").write_text(json.dumps(doc))
+    errors = check_artifacts.check_artifacts(str(tmp_path))
+    assert any("no committed cross-gateway" in e for e in errors)
+
+    doc["invariants"]["checks"] = []
+    doc["migration"]["committed"] = 1
+    (tmp_path / "SOAK_GLOBAL_r99.json").write_text(json.dumps(doc))
+    errors = check_artifacts.check_artifacts(str(tmp_path))
+    assert any("missing invariant check" in e for e in errors)
