@@ -1,0 +1,24 @@
+"""tpulint: the project-invariant static-analysis suite.
+
+Turns the conventions seven PRs of soak-proven machinery rely on into
+mechanical, tier-1-gated checks: proto/pb2 drift + one global msgType
+registry, no blocking calls in async paths, no per-connection
+device->host readbacks in tick paths, double-entry counter/ledger
+accounting, and exception hygiene in the hot paths.
+
+Driver: ``scripts/analyze.py`` (``--changed`` for pre-commit).
+Docs: ``doc/analysis.md``.  Gate: ``tests/test_analysis.py``.
+"""
+
+from .engine import (  # noqa: F401
+    BASELINE_FILE,
+    Baseline,
+    Finding,
+    ModuleInfo,
+    RepoContext,
+    Report,
+    Rule,
+    load_repo,
+    run_analysis,
+)
+from .rules import ALL_RULES, make_rules  # noqa: F401

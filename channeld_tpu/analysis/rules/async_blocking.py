@@ -1,0 +1,83 @@
+"""async-blocking: no synchronous blocking calls inside ``async def``.
+
+The gateway is a single event loop: one ``time.sleep`` or sync socket
+dial inside a coroutine stalls EVERY channel tick, trunk heartbeat and
+client read for its duration — the exact failure mode the tick-budget
+anomaly trigger exists to catch at runtime (doc/observability.md).
+This rule catches it at lint time instead, across the event-loop
+planes: core, federation, spatial.
+
+Closures defined inside an ``async def`` are included: they run inline
+on the loop unless explicitly shipped to an executor (if one ever is,
+suppress with an inline ``# tpulint: disable`` and a reason).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..astutil import call_name, direct_body_nodes, import_aliases, iter_functions
+from ..engine import Finding, ModuleInfo, RepoContext, Rule
+
+SCOPE_GLOBS = (
+    "channeld_tpu/core/*.py",
+    "channeld_tpu/federation/*.py",
+    "channeld_tpu/spatial/*.py",
+)
+
+# Canonical call name -> short description of why it blocks.
+BLOCKING_CALLS = {
+    "time.sleep": "blocks the event loop; use await asyncio.sleep",
+    "os.system": "spawns and WAITS for a shell on the loop",
+    "os.popen": "synchronous pipe I/O on the loop",
+    "subprocess.run": "synchronous subprocess wait on the loop",
+    "subprocess.call": "synchronous subprocess wait on the loop",
+    "subprocess.check_call": "synchronous subprocess wait on the loop",
+    "subprocess.check_output": "synchronous subprocess wait on the loop",
+    "subprocess.getoutput": "synchronous subprocess wait on the loop",
+    "subprocess.Popen": "subprocess spawn blocks on fork/exec",
+    "socket.create_connection": "synchronous TCP dial on the loop",
+    "socket.socket": "raw sync socket in a coroutine",
+    "socket.getaddrinfo": "synchronous DNS resolution on the loop",
+    "open": "synchronous file open/read on the loop",
+    "time.sleep_ms": "blocks the event loop",
+}
+
+
+class AsyncBlockingRule(Rule):
+    name = "async-blocking"
+    description = (
+        "no time.sleep / sync socket / file I/O / subprocess calls "
+        "inside async def (core, federation, spatial)"
+    )
+
+    def check_module(self, mod: ModuleInfo, repo: RepoContext) -> list[Finding]:
+        import fnmatch
+
+        if not any(fnmatch.fnmatch(mod.rel, g) for g in SCOPE_GLOBS):
+            return []
+        aliases = import_aliases(mod.tree)
+        findings: list[Finding] = []
+        for fn in iter_functions(mod.tree):
+            if not fn.in_async:
+                continue
+            for node in direct_body_nodes(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node, aliases)
+                if name is None:
+                    continue
+                # Normalize relative-import tails ("..core.time.sleep"
+                # never happens for stdlib; aliases already canonical).
+                why = BLOCKING_CALLS.get(name)
+                if why is None:
+                    continue
+                findings.append(Finding(
+                    rule=self.name,
+                    path=mod.rel,
+                    line=node.lineno,
+                    message=f"blocking call {name}() in async context: {why}",
+                    detector=name,
+                    scope=fn.qualname,
+                ))
+        return findings

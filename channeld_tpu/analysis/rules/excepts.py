@@ -1,0 +1,111 @@
+"""except-hygiene: no silently swallowed broad excepts in hot paths.
+
+A ``except Exception: pass`` in a tick, trunk or adoption path turns a
+real failure (an undecodable frame, a half-applied handover, a device
+error) into an invisible one — the soak's accounting then disagrees
+with reality with nothing on the record.  In scope paths a broad
+except must leave a trace: re-raise, bump a metric, log at warning+
+(warn+ records feed the ``logs`` metric), or open a flight-recorder
+span/event.  ``logger.debug`` does not count — it is off the record at
+default levels.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..astutil import dotted, iter_functions
+from ..engine import Finding, ModuleInfo, RepoContext, Rule, match_scope
+
+# Same shape as readback's HOT_PATHS, broadened to every trunk/adoption
+# handler plus channel tick internals: the paths where accounting
+# exactness is soak-asserted.
+SCOPE: tuple[tuple[str, str], ...] = (
+    ("channeld_tpu/spatial/tpu_controller.py",
+     r"^(tick|_apply_follow_interests|_publish_due|_reap_followers)$"),
+    ("channeld_tpu/spatial/grid.py", r"^_orchestrate"),
+    ("channeld_tpu/spatial/controller.py", r"^tick$"),
+    ("channeld_tpu/core/channel.py",
+     r"^(tick_once|_tick_messages|_tick_connections|"
+     r"_tick_recoverable_subscriptions|_deliver_forward_batch)$"),
+    ("channeld_tpu/federation/trunk.py",
+     r"^(send|_dispatch|_read_loop|_heartbeat_loop|_on_heartbeat)$"),
+    ("channeld_tpu/federation/plane.py",
+     r"^(initiate_handover|_handle_|_on_|_commit_batch|_abort_batch|"
+     r"_dst_fanout|_send_src_fanout|_reoffer_parked|_flush_abort_notices)"),
+    ("channeld_tpu/federation/control.py",
+     r"^(_epoch_tick|_on_|_process_death|_begin_|_advance_|_finalize_|"
+     r"_kick_drain|_census_advance|_restore_unclaimed|_evacuate_|"
+     r"_replicate|_check_)"),
+)
+
+_LOG_OK = {"warning", "error", "exception", "critical"}
+_ACCOUNT_CALLS = {"_count", "_note", "_event", "count_shed", "append_event",
+                  "span", "event", "stage"}
+
+
+def _absolved(handler: ast.ExceptHandler) -> bool:
+    """True when the handler body leaves a trace (raise / metric /
+    warn+ log / trace span / ledger call)."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func)
+        if name is None:
+            continue
+        parts = name.split(".")
+        tail = parts[-1]
+        if tail in _LOG_OK:
+            return True
+        if "metrics" in parts[:-1] and tail in ("inc", "dec", "set",
+                                                "observe", "labels"):
+            return True
+        if tail in ("inc", "dec", "observe") and "labels" in parts:
+            return True
+        if tail in _ACCOUNT_CALLS:
+            return True
+    return False
+
+
+class ExceptHygieneRule(Rule):
+    name = "except-hygiene"
+    description = (
+        "broad excepts in tick/trunk/adoption paths must re-raise, bump "
+        "a metric, log at warning+, or record a trace span"
+    )
+
+    def check_module(self, mod: ModuleInfo, repo: RepoContext) -> list[Finding]:
+        scoped = [fn for fn in iter_functions(mod.tree)
+                  if match_scope(mod.rel, fn.name, SCOPE)]
+        if not scoped:
+            return []
+        findings: list[Finding] = []
+        for fn in scoped:
+            for node in ast.walk(fn.node):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                def _broad_name(t: ast.AST) -> bool:
+                    return (isinstance(t, ast.Name)
+                            and t.id in ("Exception", "BaseException"))
+
+                broad = (
+                    node.type is None
+                    or _broad_name(node.type)
+                    or (isinstance(node.type, ast.Tuple)
+                        and any(_broad_name(e) for e in node.type.elts))
+                )
+                if not broad or _absolved(node):
+                    continue
+                findings.append(Finding(
+                    rule=self.name,
+                    path=mod.rel,
+                    line=node.lineno,
+                    message="broad except swallows the failure with no "
+                            "metric, warn+ log, span, or re-raise on the "
+                            "record",
+                    detector="swallowed-broad-except",
+                    scope=fn.qualname,
+                ))
+        return findings

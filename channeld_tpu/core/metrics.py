@@ -232,7 +232,8 @@ redirects = Counter(
     "redirects",
     "ClientRedirectMessages issued (one per client steered to the "
     "gateway now hosting its interest anchor; staged recovery handle "
-    "confirmed by the destination before each send)",
+    "confirmed by the destination before each send; the python ledger "
+    "in federation/plane.py must match exactly)",
     registry=registry,
 )
 trunk_rtt_ms = Histogram(
@@ -311,7 +312,8 @@ overload_sheds = Counter(
     "follow_interest_defer: follower-interest passes skipped; "
     "admission_connection / admission_subscription: L3 refusals with a "
     "ServerBusyMessage; admission_accept: raw CLIENT accepts refused at "
-    "the socket past the unauthenticated-backlog headroom)",
+    "the socket past the unauthenticated-backlog headroom. The python "
+    "ledger in core/overload.py (shed_counts) must match exactly)",
     ["reason"],
     registry=registry,
 )

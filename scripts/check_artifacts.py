@@ -149,7 +149,17 @@ def check_artifacts(repo: str = REPO) -> list[str]:
 DOC_GLOBS = ("doc/*.md", "README.md")
 
 _TOTAL_RE = re.compile(r"\b([a-z][a-z0-9_]*)_total\b")
-_BRACED_RE = re.compile(r"`([a-z][a-z0-9_]*)\{[a-zA-Z_,=\" ]*\}`")
+_BRACED_RE = re.compile(r"`([a-z][a-z0-9_]*)\{([a-zA-Z_0-9,=\" ]*)\}`")
+# Braced refs inside committed artifact JSON appear within string
+# values ("... overload_sheds_total{reason} ..."), where exposition
+# pairs carry JSON-escaped quotes (backend=\"host\"). The name must
+# abut the brace and the label text allows no bare quote or brace, so
+# JSON structure itself ("stats": {...}) can never match.
+# no lookbehind char may extend the name or be a backslash: embedded
+# stdout in old bench artifacts contains escaped "\n{...}" sequences
+# whose 'n' would otherwise read as a one-letter metric name.
+_ARTIFACT_BRACED_RE = re.compile(
+    r'(?<![A-Za-z0-9_\\])([a-z][a-z0-9_]*)\{((?:[^}{"\\\n]|\\")+)\}')
 
 
 def registered_metric_names() -> set[str]:
@@ -161,27 +171,108 @@ def registered_metric_names() -> set[str]:
     return names
 
 
+def registered_label_sets() -> dict[str, set[str]]:
+    """{family name: declared label names} for every metric object in
+    core/metrics.py (a labelless family maps to an empty set)."""
+    from channeld_tpu.core import metrics as m
+
+    out: dict[str, set[str]] = {}
+    for obj in vars(m).values():
+        name = getattr(obj, "_name", None)
+        labels = getattr(obj, "_labelnames", None)
+        if isinstance(name, str) and labels is not None:
+            out[name] = set(labels)
+    return out
+
+
+def _parse_ref_labels(inner: str) -> set[str]:
+    """Label names from the inside of a ``name{...}`` reference —
+    either bare names (``stage``, ``cell,direction``) or exposition
+    pairs (``reason="handover_defer"``)."""
+    labels: set[str] = set()
+    for part in inner.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        labels.add(part.split("=", 1)[0].strip().strip('"'))
+    return labels
+
+
+def _check_metric_refs(
+    where: str, totals: set[str], braced: list[tuple[str, str]],
+    names: set[str], label_sets: dict[str, set[str]],
+) -> list[str]:
+    """Shared doc/artifact validation: every referenced family exists
+    and every braced reference cites EXACTLY the declared label set
+    (a doc citing a stale label drifts silently otherwise)."""
+    errors: list[str] = []
+    refs: set[str] = set(totals)
+    for base, _ in braced:
+        refs.add(base[:-6] if base.endswith("_total") else base)
+    for ref in sorted(refs):
+        if ref not in names:
+            errors.append(
+                f"{where}: references metric {ref!r} not registered in "
+                f"core/metrics.py"
+            )
+    for base, inner in braced:
+        family = base[:-6] if base.endswith("_total") else base
+        declared = label_sets.get(family)
+        if declared is None:
+            continue  # unknown family already reported above
+        used = _parse_ref_labels(inner)
+        if used != declared:
+            errors.append(
+                f"{where}: metric {family!r} referenced with labels "
+                f"{sorted(used)} but core/metrics.py declares "
+                f"{sorted(declared)}"
+            )
+    return errors
+
+
 def check_doc_metrics(repo: str = REPO) -> list[str]:
     names = registered_metric_names()
+    label_sets = registered_label_sets()
     errors: list[str] = []
     for pattern in DOC_GLOBS:
         for path in sorted(glob.glob(os.path.join(repo, pattern))):
             text = open(path).read()
-            refs: set[str] = set(_TOTAL_RE.findall(text))
-            for base in _BRACED_RE.findall(text):
-                refs.add(base[:-6] if base.endswith("_total") else base)
-            for ref in sorted(refs):
-                if ref not in names:
-                    errors.append(
-                        f"{os.path.relpath(path, repo)}: references "
-                        f"metric {ref!r} not registered in "
-                        f"core/metrics.py"
-                    )
+            errors.extend(_check_metric_refs(
+                os.path.relpath(path, repo),
+                set(_TOTAL_RE.findall(text)),
+                _BRACED_RE.findall(text),
+                names, label_sets,
+            ))
+    return errors
+
+
+def check_artifact_metrics(repo: str = REPO) -> list[str]:
+    """Metric references inside committed soak/bench/trace artifacts
+    (invariant-check names cite families with their label sets) must
+    also exist and carry the declared labels."""
+    names = registered_metric_names()
+    label_sets = registered_label_sets()
+    errors: list[str] = []
+    for pattern in ("SOAK_*.json", "BENCH_*.json", "TRACE_*.json"):
+        for path in sorted(glob.glob(os.path.join(repo, pattern))):
+            text = open(path).read()
+            braced = _ARTIFACT_BRACED_RE.findall(text)
+            # Artifacts carry free-form soak-local stat keys that may
+            # end in _total; only braced refs (deliberate metric
+            # citations, label set included) and bare _total tokens
+            # matching a registered family are validated.
+            totals = {
+                base for base in _TOTAL_RE.findall(text) if base in names
+            }
+            errors.extend(_check_metric_refs(
+                os.path.basename(path), totals, braced, names, label_sets,
+            ))
     return errors
 
 
 def main() -> int:
-    errors = check_artifacts() + check_doc_metrics()
+    errors = (check_artifacts() + check_doc_metrics()
+              + check_artifact_metrics())
     if errors:
         for e in errors:
             print(f"DRIFT: {e}")

@@ -1,0 +1,100 @@
+"""Regenerate ``*_pb2.py`` modules from their ``.proto`` sources — the
+descriptor-rewrite regen path (there is no protoc in the image).
+
+Usage:
+    python scripts/regen_pb2.py channeld_tpu/protocol/wire.proto [...]
+    python scripts/regen_pb2.py --all          # every protocol/ schema
+    python scripts/regen_pb2.py --check --all  # diff only, exit 1 on drift
+
+The pure-python compiler (``channeld_tpu/analysis/protoparse.py``)
+builds a ``FileDescriptorProto`` byte-identical to protoc's for the
+proto3 subset the project uses; explicit ``json_name`` cosmetics on
+hand-added fields are carried over from the committed pb2 so an
+otherwise-untouched schema regenerates diff-free.  The emitted module
+matches the committed protoc-3.20 ``_builder`` layout, offsets table
+included.  ``tests/test_analysis.py`` round-trips every protocol schema
+through this script and diffs against the committed pb2 on each tier-1
+run.
+
+Scope: schemas under ``channeld_tpu/protocol/`` (the wire contract the
+proto-drift rule gates).  The models/ops/compat schemas use protoc
+features the compiler intentionally rejects (services, field options) —
+it fails loudly on them rather than mis-compiling.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from channeld_tpu.analysis import pb2io, protoparse  # noqa: E402
+
+PROTO_DIR = "channeld_tpu/protocol"
+
+
+def regenerate(proto_rel: str, repo: str = REPO) -> tuple[str, str]:
+    """(pb2 repo-relative path, regenerated module text)."""
+    proto_path = os.path.join(repo, proto_rel)
+    pf = protoparse.parse_proto_file(proto_path, repo)
+    fdp = protoparse.build_file_descriptor(pf)
+    pb2_rel = proto_rel[:-len(".proto")] + "_pb2.py"
+    pb2_path = os.path.join(repo, pb2_rel)
+    if os.path.exists(pb2_path):
+        with open(pb2_path, encoding="utf-8") as fh:
+            committed = pb2io.parse_pb2_descriptor(fh.read(), pb2_rel)
+        pb2io.carry_over_json_names(fdp, committed)
+    module_name = pb2_rel[:-len(".py")].replace("/", ".")
+    return pb2_rel, pb2io.emit_pb2_module(fdp, module_name)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("protos", nargs="*",
+                    help=".proto paths (repo-relative or absolute)")
+    ap.add_argument("--all", action="store_true",
+                    help=f"regenerate every schema under {PROTO_DIR}/")
+    ap.add_argument("--check", action="store_true",
+                    help="do not write; exit 1 if a pb2 would change")
+    args = ap.parse_args(argv)
+
+    protos = list(args.protos)
+    if args.all:
+        protos.extend(sorted(
+            os.path.relpath(p, REPO)
+            for p in glob.glob(os.path.join(REPO, PROTO_DIR, "*.proto"))
+        ))
+    if not protos:
+        ap.error("no .proto given (or use --all)")
+
+    drifted = 0
+    for proto in protos:
+        rel = os.path.relpath(os.path.abspath(proto), REPO) \
+            if os.path.isabs(proto) else proto
+        rel = rel.replace(os.sep, "/")
+        pb2_rel, text = regenerate(rel, REPO)
+        pb2_path = os.path.join(REPO, pb2_rel)
+        current = None
+        if os.path.exists(pb2_path):
+            with open(pb2_path, encoding="utf-8") as fh:
+                current = fh.read()
+        if current == text:
+            print(f"unchanged: {pb2_rel}")
+            continue
+        if args.check:
+            print(f"WOULD REWRITE: {pb2_rel}")
+            drifted += 1
+            continue
+        with open(pb2_path, "w", encoding="utf-8") as fh:
+            fh.write(text)
+        print(f"rewrote: {pb2_rel}")
+    return 1 if drifted else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

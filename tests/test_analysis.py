@@ -1,0 +1,832 @@
+"""Tier-1 gate for tpulint (channeld_tpu/analysis; doc/analysis.md).
+
+Three layers:
+
+1. **Fixture tests** — every rule proves it catches a seeded violation
+   (including an injected pb2 field-number drift for proto-drift) and
+   stays quiet on the compliant twin, so a rule regression fails here
+   rather than silently passing drifted code.
+2. **Mechanics** — inline suppressions require reasons, baseline
+   entries suppress / go stale / fail without reasons.
+3. **The smoke gate** — ``scripts/analyze.py`` over the WHOLE repo with
+   the committed baseline must be clean (this is the analyzer's tier-1
+   invocation; well under the 60s budget), and every protocol schema
+   must round-trip byte-identically through ``scripts/regen_pb2.py``.
+"""
+
+import ast
+import glob
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+from channeld_tpu.analysis import (  # noqa: E402
+    Baseline,
+    ModuleInfo,
+    RepoContext,
+    load_repo,
+    make_rules,
+    run_analysis,
+)
+from channeld_tpu.analysis import pb2io, protoparse  # noqa: E402
+from channeld_tpu.analysis.rules.accounting import DoubleEntryRule  # noqa: E402
+from channeld_tpu.analysis.rules.async_blocking import (  # noqa: E402
+    AsyncBlockingRule,
+)
+from channeld_tpu.analysis.rules.excepts import ExceptHygieneRule  # noqa: E402
+from channeld_tpu.analysis.rules.proto_drift import (  # noqa: E402
+    ProtoDriftRule,
+    check_proto_pair,
+)
+from channeld_tpu.analysis.rules.readback import (  # noqa: E402
+    HotPathReadbackRule,
+)
+
+
+def mod(rel: str, text: str) -> ModuleInfo:
+    return ModuleInfo(path=rel, rel=rel, text=text,
+                      tree=ast.parse(text), lines=text.split("\n"))
+
+
+def ctx(*mods: ModuleInfo, root: str = REPO) -> RepoContext:
+    return RepoContext(root=root, modules=list(mods))
+
+
+# ---------------------------------------------------------------------------
+# rule fixtures: async-blocking
+# ---------------------------------------------------------------------------
+
+TRUNK_REL = "channeld_tpu/federation/trunk.py"
+
+
+def test_async_blocking_flags_time_sleep_in_async_def():
+    m = mod(TRUNK_REL, (
+        "import time\n"
+        "async def _read_loop(self):\n"
+        "    time.sleep(0.1)\n"
+    ))
+    findings = AsyncBlockingRule().check_module(m, ctx(m))
+    assert [f.detector for f in findings] == ["time.sleep"]
+    assert findings[0].scope == "_read_loop"
+
+
+def test_async_blocking_resolves_aliases_and_closures():
+    m = mod(TRUNK_REL, (
+        "import time as _time\n"
+        "import subprocess\n"
+        "async def pump(self):\n"
+        "    def _drain():\n"
+        "        _time.sleep(1)\n"          # closure runs on the loop
+        "    subprocess.check_output(['x'])\n"
+        "    open('/tmp/f').read()\n"
+    ))
+    found = {f.detector for f in
+             AsyncBlockingRule().check_module(m, ctx(m))}
+    assert found == {"time.sleep", "subprocess.check_output", "open"}
+
+
+def test_async_blocking_quiet_on_sync_defs_and_asyncio_sleep():
+    m = mod(TRUNK_REL, (
+        "import asyncio, time\n"
+        "def sync_helper():\n"
+        "    time.sleep(0.5)\n"             # sync context: fine
+        "async def loop(self):\n"
+        "    await asyncio.sleep(0.5)\n"    # the correct call
+    ))
+    assert AsyncBlockingRule().check_module(m, ctx(m)) == []
+
+
+def test_async_blocking_out_of_scope_dirs_ignored():
+    m = mod("channeld_tpu/replay/harness.py", (
+        "import time\n"
+        "async def run(self):\n"
+        "    time.sleep(1)\n"
+    ))
+    assert AsyncBlockingRule().check_module(m, ctx(m)) == []
+
+
+# ---------------------------------------------------------------------------
+# rule fixtures: hot-readback
+# ---------------------------------------------------------------------------
+
+TPU_REL = "channeld_tpu/spatial/tpu_controller.py"
+
+
+def test_hot_readback_flags_item_and_single_row_calls():
+    m = mod(TPU_REL, (
+        "class C:\n"
+        "    def tick(self):\n"
+        "        x = self.engine.positions_dev.sum().item()\n"
+        "    def _apply_follow_interests(self, result):\n"
+        "        for conn_id in self.followers:\n"
+        "            d = self.engine.interested_cells(result, conn_id)\n"
+    ))
+    found = {f.detector for f in
+             HotPathReadbackRule().check_module(m, ctx(m))}
+    assert ".item()" in found
+    assert ".interested_cells()" in found
+
+
+def test_hot_readback_flags_np_and_scalar_indexing():
+    m = mod(TPU_REL, (
+        "import numpy as np\n"
+        "class C:\n"
+        "    def tick(self):\n"
+        "        rows = np.asarray(self.result_masks)\n"
+        "        v = float(self.dev_arr[3])\n"
+        "        w = self.engine.interest[5]\n"
+    ))
+    found = {f.detector for f in
+             HotPathReadbackRule().check_module(m, ctx(m))}
+    assert found == {"np.asarray", "float(subscript)", "engine-subscript"}
+
+
+def test_hot_readback_quiet_on_batched_helper_and_cold_paths():
+    m = mod(TPU_REL, (
+        "class C:\n"
+        "    def _apply_follow_interests(self, result, live):\n"
+        "        d = self.engine.interested_cells_batch(result, live)\n"
+        "    def boot(self):\n"                    # not a hot path
+        "        x = self.engine.positions.item()\n"
+    ))
+    assert HotPathReadbackRule().check_module(m, ctx(m)) == []
+
+
+# ---------------------------------------------------------------------------
+# rule fixtures: double-entry
+# ---------------------------------------------------------------------------
+
+METRICS_REL = "channeld_tpu/core/metrics.py"
+_METRICS_SRC = (
+    "from prometheus_client import Counter, Gauge\n"
+    "sheds = Counter('sheds', 'work shed; the python ledger must match',"
+    " ['reason'])\n"
+    "plain = Counter('plain', 'no ledger here')\n"
+    "level = Gauge('level', 'a gauge')\n"
+)
+
+
+def _de_ctx(user_src: str):
+    mm = mod(METRICS_REL, _METRICS_SRC)
+    um = mod("channeld_tpu/core/overload.py", user_src)
+    return um, ctx(mm, um)
+
+
+def test_double_entry_flags_unpaired_ledgered_bump():
+    um, c = _de_ctx(
+        "from . import metrics\n"
+        "class G:\n"
+        "    def shed(self, reason):\n"
+        "        metrics.sheds.labels(reason=reason).inc()\n"  # no ledger
+    )
+    found = [f.detector for f in DoubleEntryRule().check_module(um, c)]
+    assert found == ["unpaired:sheds"]
+
+
+def test_double_entry_paired_bump_is_clean():
+    um, c = _de_ctx(
+        "from . import metrics\n"
+        "class G:\n"
+        "    def shed(self, reason):\n"
+        "        self.counts[reason] = self.counts.get(reason, 0) + 1\n"
+        "        metrics.sheds.labels(reason=reason).inc()\n"
+    )
+    assert DoubleEntryRule().check_module(um, c) == []
+
+
+def test_double_entry_label_set_must_match_declaration():
+    um, c = _de_ctx(
+        "from . import metrics\n"
+        "def f():\n"
+        "    metrics.sheds.labels(cause='x').inc()\n"      # wrong label
+        "    metrics.sheds.labels('x').inc()\n"            # positional
+        "    metrics.level.labels(kind='x').set(1)\n"      # unlabeled
+    )
+    found = {f.detector for f in DoubleEntryRule().check_module(um, c)}
+    assert found >= {"label-mismatch:sheds", "positional-labels:sheds",
+                     "labels-on-unlabeled:level"}
+
+
+def test_double_entry_flags_undeclared_and_unlabeled_bumps():
+    um, c = _de_ctx(
+        "from . import metrics\n"
+        "class G:\n"
+        "    def f(self):\n"
+        "        self.counts['x'] = 1\n"
+        "        metrics.ghost.inc()\n"         # not declared
+        "        metrics.sheds.inc()\n"         # labeled family, bare bump
+    )
+    found = {f.detector for f in DoubleEntryRule().check_module(um, c)}
+    assert found == {"undeclared:ghost", "missing-labels:sheds"}
+
+
+def test_double_entry_validates_real_metrics_declarations():
+    """The real core/metrics.py parses and declares the six soak-proven
+    double-entry families as ledgered."""
+    from channeld_tpu.analysis.rules.accounting import parse_metric_decls
+
+    repo = load_repo(REPO)
+    decls = parse_metric_decls(repo.module(METRICS_REL))
+    ledgered = {d.attr for d in decls.values() if d.ledgered}
+    assert {"overload_sheds", "balancer_migrations", "federation_handover",
+            "global_migrations", "gateway_adoptions",
+            "handover_journal", "redirects"} <= ledgered
+
+
+# ---------------------------------------------------------------------------
+# rule fixtures: except-hygiene
+# ---------------------------------------------------------------------------
+
+def test_except_hygiene_flags_swallowed_broad_except():
+    m = mod(TRUNK_REL, (
+        "class L:\n"
+        "    def _dispatch(self, mp):\n"
+        "        try:\n"
+        "            self.apply(mp)\n"
+        "        except Exception:\n"
+        "            pass\n"
+    ))
+    found = ExceptHygieneRule().check_module(m, ctx(m))
+    assert [f.detector for f in found] == ["swallowed-broad-except"]
+    assert found[0].scope == "L._dispatch"
+
+
+def test_except_hygiene_accepts_metric_log_span_or_raise():
+    m = mod(TRUNK_REL, (
+        "class L:\n"
+        "    def _dispatch(self, mp):\n"
+        "        try:\n"
+        "            self.apply(mp)\n"
+        "        except Exception:\n"
+        "            logger.error('undecodable %s', mp)\n"
+        "    def _read_loop(self):\n"
+        "        try:\n"
+        "            self.step()\n"
+        "        except Exception:\n"
+        "            metrics.chaos_faults.labels(point='x').inc()\n"
+        "    def _on_heartbeat(self, m):\n"
+        "        try:\n"
+        "            self.rtt(m)\n"
+        "        except Exception:\n"
+        "            raise\n"
+    ))
+    assert ExceptHygieneRule().check_module(m, ctx(m)) == []
+
+
+def test_except_hygiene_narrow_excepts_and_cold_paths_are_fine():
+    m = mod(TRUNK_REL, (
+        "class L:\n"
+        "    def _dispatch(self, mp):\n"
+        "        try:\n"
+        "            self.apply(mp)\n"
+        "        except (ConnectionError, OSError):\n"
+        "            pass\n"                       # narrow: allowed
+        "    def close(self):\n"                   # teardown: out of scope
+        "        try:\n"
+        "            self.w.close()\n"
+        "        except Exception:\n"
+        "            pass\n"
+    ))
+    assert ExceptHygieneRule().check_module(m, ctx(m)) == []
+
+
+# ---------------------------------------------------------------------------
+# rule fixtures: proto-drift (schema diff on an injected drifted pb2)
+# ---------------------------------------------------------------------------
+
+_FIXTURE_PROTO = (
+    'syntax = "proto3";\n'
+    "package fix;\n"
+    "// Overload refusal (msgType 24).\n"
+    "message Busy {\n"
+    "    string reason = 1;\n"
+    "    uint32 retryAfterMs = 2;\n"
+    "    repeated uint32 ids = 3;\n"
+    "    optional bool hard = 4;\n"
+    "}\n"
+    "enum Kind {\n"
+    "    NONE = 0;\n"
+    "    SOFT = 1;\n"
+    "}\n"
+)
+
+
+def _write_fixture(tmp_path, mutate=None):
+    proto = tmp_path / "fix.proto"
+    proto.write_text(_FIXTURE_PROTO)
+    pf = protoparse.parse_proto_file(str(proto), str(tmp_path))
+    fdp = protoparse.build_file_descriptor(pf)
+    if mutate is not None:
+        mutate(fdp)
+    pb2 = tmp_path / "fix_pb2.py"
+    pb2.write_text(pb2io.emit_pb2_module(fdp, "fix_pb2"))
+    return str(proto), str(pb2)
+
+
+def test_proto_drift_clean_pair_has_no_findings(tmp_path):
+    proto, pb2 = _write_fixture(tmp_path)
+    assert check_proto_pair(proto, pb2, str(tmp_path)) == []
+
+
+def test_proto_drift_catches_injected_field_number_drift(tmp_path):
+    def renumber(fdp):
+        # The classic hand-regen mistake: retryAfterMs 2 -> 5.
+        fdp.message_type[0].field[1].number = 5
+
+    proto, pb2 = _write_fixture(tmp_path, renumber)
+    findings = check_proto_pair(proto, pb2, str(tmp_path))
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "proto-drift"
+    assert "retryAfterMs" in f.message
+    assert "= 5" in f.message and "= 2" in f.message
+
+
+def test_proto_drift_catches_type_label_and_presence_drift(tmp_path):
+    def mutate(fdp):
+        busy = fdp.message_type[0]
+        busy.field[0].type = 12          # string -> bytes
+        busy.field[2].label = 1          # repeated -> singular
+    proto, pb2 = _write_fixture(tmp_path, mutate)
+    drifted = {f.detector for f in check_proto_pair(proto, pb2,
+                                                    str(tmp_path))}
+    assert drifted == {"fix.Busy.reason", "fix.Busy.ids"}
+
+
+def test_proto_drift_catches_missing_message_and_enum_value(tmp_path):
+    def mutate(fdp):
+        del fdp.message_type[:]
+        del fdp.enum_type[0].value[1]    # drop SOFT
+    proto, pb2 = _write_fixture(tmp_path, mutate)
+    msgs = [f.message for f in check_proto_pair(proto, pb2, str(tmp_path))]
+    assert any("message fix.Busy in .proto missing from pb2" in m
+               for m in msgs)
+    assert any("enum value SOFT=1 in .proto missing" in m for m in msgs)
+
+
+def test_proto_drift_real_schemas_are_clean():
+    for proto in sorted(glob.glob(
+            os.path.join(REPO, "channeld_tpu/protocol/*.proto"))):
+        pb2 = proto[:-len(".proto")] + "_pb2.py"
+        assert check_proto_pair(proto, pb2, REPO) == [], proto
+
+
+# ---------------------------------------------------------------------------
+# proto-drift: msgType registry fixtures
+# ---------------------------------------------------------------------------
+
+def _registry_ctx(tmp_path, types_src: str, wire_proto: str):
+    proto_dir = tmp_path / "channeld_tpu" / "protocol"
+    proto_dir.mkdir(parents=True)
+    (proto_dir / "wire.proto").write_text(wire_proto)
+    pf = protoparse.parse_proto_file(str(proto_dir / "wire.proto"),
+                                     str(tmp_path))
+    fdp = protoparse.build_file_descriptor(pf)
+    (proto_dir / "wire_pb2.py").write_text(
+        pb2io.emit_pb2_module(fdp, "wire_pb2"))
+    return ctx(mod("channeld_tpu/core/types.py", types_src),
+               root=str(tmp_path))
+
+
+_WIRE_OK = (
+    'syntax = "proto3";\npackage chtpu;\n'
+    "enum MessageType {\n    INVALID = 0;\n    SERVER_BUSY = 24;\n}\n"
+    "// Refusal (msgType 24).\nmessage ServerBusyMessage {\n"
+    "    string reason = 1;\n}\n"
+)
+
+
+def test_registry_flags_duplicate_and_out_of_range_msgtypes(tmp_path):
+    c = _registry_ctx(tmp_path, (
+        "class MessageType:\n"
+        "    INVALID = 0\n"
+        "    SERVER_BUSY = 24\n"
+        "    IMPOSTER = 24\n"       # duplicate value
+        "    ROGUE = 57\n"          # outside 24-45
+    ), _WIRE_OK)
+    found = {f.detector for f in ProtoDriftRule().check_repo(c)}
+    assert "dup:24" in found
+    assert "range:ROGUE" in found
+
+
+def test_registry_flags_wire_enum_gap_and_unclaimed_extension(tmp_path):
+    c = _registry_ctx(tmp_path, (
+        "class MessageType:\n"
+        "    INVALID = 0\n"
+        "    SERVER_BUSY = 24\n"
+        "    CELL_REHOSTED = 25\n"  # not in wire.proto enum, unclaimed
+    ), _WIRE_OK)
+    found = {f.detector for f in ProtoDriftRule().check_repo(c)}
+    assert "wire-missing:CELL_REHOSTED" in found
+    assert "unclaimed:CELL_REHOSTED" in found
+    # 24 is in the wire enum, claimed by the ServerBusyMessage comment,
+    # registered in no template map -> exactly the unregistered finding.
+    assert "unregistered:SERVER_BUSY" in found
+    assert "unclaimed:SERVER_BUSY" not in found
+
+
+def test_registry_real_repo_is_clean():
+    repo = load_repo(REPO)
+    findings = ProtoDriftRule().check_repo(repo)
+    assert findings == [], [f.render() for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# engine mechanics: inline suppressions + baseline
+# ---------------------------------------------------------------------------
+
+_VIOLATION = (
+    "import time\n"
+    "async def _read_loop(self):\n"
+    "    time.sleep(0.1){}\n"
+)
+
+
+def test_inline_suppression_requires_reason():
+    m = mod(TRUNK_REL, _VIOLATION.format(
+        "  # tpulint: disable=async-blocking"))
+    report = run_analysis(ctx(m), [AsyncBlockingRule()])
+    # The violation is NOT suppressed and the reasonless directive is
+    # itself a finding.
+    assert {f.rule for f in report.findings} == {"tpulint",
+                                                 "async-blocking"}
+
+
+def test_inline_suppression_with_reason_suppresses():
+    m = mod(TRUNK_REL, _VIOLATION.format(
+        "  # tpulint: disable=async-blocking -- executor-bound in caller"))
+    report = run_analysis(ctx(m), [AsyncBlockingRule()])
+    assert report.findings == []
+    assert len(report.suppressed) == 1
+    assert report.ok
+
+
+def test_baseline_suppresses_and_goes_stale():
+    m = mod(TRUNK_REL, _VIOLATION.format(""))
+    key = ("async-blocking:channeld_tpu/federation/trunk.py:"
+           "_read_loop:time.sleep")
+    bl = Baseline(entries={key: "known debt, tracked in ROADMAP",
+                           "async-blocking:gone.py::time.sleep": "stale"})
+    report = run_analysis(ctx(m), [AsyncBlockingRule()], bl)
+    assert report.findings == []
+    assert report.suppressed[0][1] == "known debt, tracked in ROADMAP"
+    assert report.stale_baseline == ["async-blocking:gone.py::time.sleep"]
+    assert report.ok
+
+
+def test_baseline_entry_without_reason_fails_the_run():
+    m = mod(TRUNK_REL, _VIOLATION.format(""))
+    key = ("async-blocking:channeld_tpu/federation/trunk.py:"
+           "_read_loop:time.sleep")
+    report = run_analysis(ctx(m), [AsyncBlockingRule()],
+                          Baseline(entries={key: ""}))
+    assert report.findings == []
+    assert report.unreasoned_baseline == [key]
+    assert not report.ok
+
+
+def test_changed_mode_filters_to_changed_files():
+    clean = mod("channeld_tpu/core/other.py", "x = 1\n")
+    dirty = mod(TRUNK_REL, _VIOLATION.format(""))
+    repo = RepoContext(root=REPO, modules=[clean, dirty],
+                       changed={"channeld_tpu/core/other.py"})
+    report = run_analysis(repo, [AsyncBlockingRule()])
+    assert report.findings == []          # violation is outside the set
+    repo.changed = {TRUNK_REL}
+    report = run_analysis(repo, [AsyncBlockingRule()])
+    assert len(report.findings) == 1
+
+
+# ---------------------------------------------------------------------------
+# satellite: regen round-trip + the tier-1 smoke gate
+# ---------------------------------------------------------------------------
+
+def test_regen_round_trip_matches_committed_pb2():
+    """scripts/regen_pb2.py regenerated from .proto must reproduce every
+    committed protocol pb2 byte-for-byte (descriptor blob AND module
+    text) — the descriptor-rewrite regen path stays trustworthy."""
+    import regen_pb2
+
+    protos = sorted(glob.glob(
+        os.path.join(REPO, "channeld_tpu/protocol/*.proto")))
+    assert len(protos) >= 5
+    for proto in protos:
+        rel = os.path.relpath(proto, REPO)
+        pb2_rel, text = regen_pb2.regenerate(rel, REPO)
+        with open(os.path.join(REPO, pb2_rel), encoding="utf-8") as fh:
+            committed = fh.read()
+        assert text == committed, f"{pb2_rel} drifted from {rel}"
+
+
+def test_regen_check_mode_detects_drift(tmp_path, monkeypatch):
+    import regen_pb2
+
+    proto, pb2 = _write_fixture(
+        tmp_path, lambda fdp: fdp.message_type[0].field.pop())
+    monkeypatch.setattr(regen_pb2, "REPO", str(tmp_path))
+    rc = regen_pb2.main(["--check", "fix.proto"])
+    assert rc == 1
+
+
+def test_analyzer_full_repo_is_clean():
+    """THE tier-1 smoke invocation: the full suite over the whole repo
+    with the committed baseline runs clean (and fast)."""
+    import time
+
+    import analyze
+
+    t0 = time.monotonic()
+    rc = analyze.main([])
+    elapsed = time.monotonic() - t0
+    assert rc == 0
+    assert elapsed < 60.0
+
+
+def test_analyzer_rule_listing_names_all_five_rules(capsys):
+    import analyze
+
+    assert analyze.main(["--list"]) == 0
+    out = capsys.readouterr().out
+    for rule in ("proto-drift", "async-blocking", "hot-readback",
+                 "double-entry", "except-hygiene"):
+        assert rule in out
+
+
+def test_wire_enum_carries_every_extension_msgtype():
+    """Regression for the drift the suite surfaced when it first ran:
+    core/types.py MessageType members 24-45 were absent from the wire
+    schema's MessageType enum (and pb2), so peers reading wire.proto
+    could not see the extension types the gateway speaks."""
+    from channeld_tpu.core.types import MessageType
+    from channeld_tpu.protocol import wire_pb2
+
+    wire_vals = {v.name: v.number
+                 for v in wire_pb2.MessageType.DESCRIPTOR.values}
+    for member in MessageType:
+        assert wire_vals.get(member.name) == member.value, member
+    assert {v for v in wire_vals.values() if 24 <= v <= 45} == \
+        {m.value for m in MessageType if 24 <= m.value <= 45}
+
+
+def test_changed_mode_driver_gates_proto_rule(monkeypatch, capsys):
+    """--changed skips the repo-wide proto-drift/registry rule unless a
+    schema/registry file changed, and reports 'no changed files' on a
+    clean tree (the pre-commit fast path)."""
+    import analyze
+
+    monkeypatch.setattr(analyze, "changed_files", lambda repo: set())
+    assert analyze.main(["--changed"]) == 0
+    assert "no changed files" in capsys.readouterr().out
+
+    monkeypatch.setattr(
+        analyze, "changed_files",
+        lambda repo: {"channeld_tpu/core/overload.py"})
+    assert analyze.main(["--changed", "--rule", "proto-drift"]) == 0
+    assert "no applicable rules" in capsys.readouterr().out
+
+    monkeypatch.setattr(
+        analyze, "changed_files",
+        lambda repo: {"channeld_tpu/protocol/wire.proto"})
+    assert analyze.main(["--changed", "--rule", "proto-drift"]) == 0
+    assert "proto-drift" not in capsys.readouterr().out.replace(
+        "1 rule(s)", "")  # the rule ran (and was clean)
+
+
+def test_changed_mode_keeps_repo_wide_proto_findings(tmp_path):
+    """A .proto edit without a pb2 regen must surface in --changed even
+    though the drift finding is attributed to the (unchanged) pb2 file
+    — the exact edit-proto-forget-regen scenario the rule exists for."""
+    proto, pb2 = _write_fixture(
+        tmp_path, lambda fdp: fdp.message_type[0].field[1].__setattr__(
+            "number", 9))
+    # Simulate the pre-commit state: only the .proto is in the changed
+    # set; the stale pb2 is not.
+    proto_dir = tmp_path / "channeld_tpu" / "protocol"
+    proto_dir.mkdir(parents=True)
+    os.rename(proto, proto_dir / "fix.proto")
+    os.rename(pb2, proto_dir / "fix_pb2.py")
+    repo = RepoContext(root=str(tmp_path), modules=[],
+                       changed={"channeld_tpu/protocol/fix.proto"})
+    report = run_analysis(repo, [ProtoDriftRule()])
+    assert any(f.rule == "proto-drift" and "retryAfterMs" in f.message
+               for f in report.findings)
+
+
+def test_async_blocking_resolves_dotted_module_imports():
+    """``import os.path`` binds the root ``os`` — os.system must still
+    resolve (the alias map must not canonicalize os -> os.path)."""
+    m = mod(TRUNK_REL, (
+        "import os.path\n"
+        "async def run(self):\n"
+        "    os.system('x')\n"
+    ))
+    found = [f.detector for f in
+             AsyncBlockingRule().check_module(m, ctx(m))]
+    assert found == ["os.system"]
+
+
+def test_registry_opaque_template_entry_is_a_finding(tmp_path):
+    """One non-literal entry in a template registry dict must surface
+    as a finding, not silently disable the whole registry's checks."""
+    proto_dir = tmp_path / "channeld_tpu" / "protocol"
+    proto_dir.mkdir(parents=True)
+    (proto_dir / "wire.proto").write_text(_WIRE_OK)
+    pf = protoparse.parse_proto_file(str(proto_dir / "wire.proto"),
+                                     str(tmp_path))
+    (proto_dir / "wire_pb2.py").write_text(pb2io.emit_pb2_module(
+        protoparse.build_file_descriptor(pf), "wire_pb2"))
+    c = RepoContext(root=str(tmp_path), modules=[
+        mod("channeld_tpu/core/types.py",
+            "class MessageType:\n    INVALID = 0\n    SERVER_BUSY = 24\n"),
+        mod("channeld_tpu/protocol/__init__.py", (
+            "from . import control_pb2\n"
+            "MESSAGE_TEMPLATES = {\n"
+            "    24: control_pb2.ServerBusyMessage,\n"
+            "    24: control_pb2.ServerBusyMessage,\n"   # dup key
+            "    compute_key(): control_pb2.Other,\n"    # opaque entry
+            "}\n")),
+    ])
+    found = {f.detector for f in ProtoDriftRule().check_repo(c)}
+    assert "opaque-entry:MESSAGE_TEMPLATES" in found
+    assert "dup-key:MESSAGE_TEMPLATES:24" in found      # checks stayed on
+
+
+def test_reasonless_stale_baseline_entry_still_fails():
+    """A baseline entry with no reason fails the run even when nothing
+    matches it any more (it must not outlive its justification)."""
+    m = mod(TRUNK_REL, "x = 1\n")
+    report = run_analysis(
+        ctx(m), [AsyncBlockingRule()],
+        Baseline(entries={"async-blocking:gone.py::time.sleep": ""}))
+    assert report.findings == []
+    assert report.unreasoned_baseline == \
+        ["async-blocking:gone.py::time.sleep"]
+    assert not report.ok
+
+
+def test_unsupported_construct_in_imported_proto_is_a_finding(tmp_path):
+    """A parse failure in an IMPORTED schema (the advertised
+    'extend-the-parser-when-needed' path) must surface as a
+    proto-parse-error finding on every dependent pair, never crash the
+    sweep — even with the repo sweep's shared parse cache."""
+    proto_dir = tmp_path / "channeld_tpu" / "protocol"
+    proto_dir.mkdir(parents=True)
+    (proto_dir / "wire.proto").write_text(
+        'syntax = "proto3";\npackage chtpu;\n'
+        "message M { map<uint32, string> bad = 1; }\n")  # unsupported
+    (proto_dir / "control.proto").write_text(
+        'syntax = "proto3";\npackage chtpu;\n'
+        'import "channeld_tpu/protocol/wire.proto";\n'
+        "message C { M m = 1; }\n")
+    for name in ("wire", "control"):
+        (proto_dir / f"{name}_pb2.py").write_text(
+            "DESCRIPTOR = POOL.AddSerializedFile(b'')\n")
+    repo = RepoContext(root=str(tmp_path), modules=[])
+    findings = ProtoDriftRule().check_repo(repo)   # must not raise
+    assert sum(f.detector == "proto-parse-error" for f in findings) == 2
+    assert all("map" in f.message or "unreadable" in f.message
+               or "'map'" in f.message for f in findings
+               if f.detector == "proto-parse-error")
+
+
+def test_proto_drift_catches_dropped_syntax_marker(tmp_path):
+    """A pb2 blob that lost `syntax = \"proto3\"` flips every field to
+    proto2 presence semantics — must be drift, not a clean pass."""
+    proto, pb2 = _write_fixture(
+        tmp_path, lambda fdp: fdp.ClearField("syntax"))
+    findings = check_proto_pair(proto, pb2, str(tmp_path))
+    assert [f.detector for f in findings] == ["syntax"]
+
+
+def test_async_blocking_sees_lambda_bodies():
+    """A blocking call smuggled into a lambda registered from a
+    coroutine runs inline on the loop — the rule must see it."""
+    m = mod(TRUNK_REL, (
+        "import time\n"
+        "async def run(self, loop):\n"
+        "    loop.call_soon(lambda: time.sleep(5))\n"
+    ))
+    found = [f.detector for f in
+             AsyncBlockingRule().check_module(m, ctx(m))]
+    assert found == ["time.sleep"]
+
+
+def test_hot_readback_sees_nested_helper_defs():
+    """A per-connection readback moved into a nested helper inside a
+    hot-path function is still on the hot path — and still flagged."""
+    m = mod(TPU_REL, (
+        "class C:\n"
+        "    def tick(self):\n"
+        "        def cost(c):\n"
+        "            return float(self.engine.costs[c])\n"
+        "        return [cost(c) for c in self.conns]\n"
+    ))
+    found = {f.detector for f in
+             HotPathReadbackRule().check_module(m, ctx(m))}
+    assert "engine-subscript" in found
+
+
+def test_changed_mode_falls_back_to_full_run_without_git(monkeypatch,
+                                                         capsys):
+    """git unavailable must NOT report a clean tree: --changed falls
+    back to a full run (which is clean on this repo) with a warning."""
+    import analyze
+
+    monkeypatch.setattr(analyze, "changed_files", lambda repo: None)
+    assert analyze.main(["--changed", "--rule", "async-blocking"]) == 0
+    captured = capsys.readouterr()
+    assert "falling back to a FULL run" in captured.err
+    assert "tpulint [full]" in captured.out
+
+
+def test_json_output_carries_unreasoned_baseline(tmp_path, capsys):
+    import json as _json
+
+    import analyze
+
+    bl = tmp_path / "bl.json"
+    bl.write_text(_json.dumps({"suppressions": [
+        {"key": "async-blocking:gone.py::time.sleep", "reason": ""}]}))
+    rc = analyze.main(["--json", "--rule", "async-blocking",
+                       "--baseline", str(bl)])
+    out = _json.loads(capsys.readouterr().out)
+    assert rc == 1 and out["ok"] is False
+    assert out["unreasoned_baseline"] == \
+        ["async-blocking:gone.py::time.sleep"]
+
+
+def test_unparseable_module_is_a_finding(tmp_path):
+    """A syntax-error module must fail the run, not silently evade
+    every rule."""
+    scripts = tmp_path / "scripts"
+    scripts.mkdir(parents=True)
+    (tmp_path / "channeld_tpu").mkdir()
+    (scripts / "broken_soak.py").write_text("def oops(:\n")
+    repo = load_repo(str(tmp_path))
+    report = run_analysis(repo, [AsyncBlockingRule()])
+    assert [f.detector for f in report.findings] == ["syntax-error"]
+    assert report.findings[0].path == "scripts/broken_soak.py"
+    assert not report.ok
+
+
+def test_except_hygiene_flags_tuple_form_broad_except():
+    """`except (Exception, OSError):` is as broad as the bare form."""
+    m = mod(TRUNK_REL, (
+        "class L:\n"
+        "    def _dispatch(self, mp):\n"
+        "        try:\n"
+        "            self.apply(mp)\n"
+        "        except (Exception, OSError):\n"
+        "            pass\n"
+    ))
+    found = [f.detector for f in
+             ExceptHygieneRule().check_module(m, ctx(m))]
+    assert found == ["swallowed-broad-except"]
+
+
+def test_proto_drift_flags_orphaned_pb2(tmp_path):
+    """A committed *_pb2.py whose .proto was deleted keeps shipping
+    wire classes with no source of truth — must be a finding."""
+    proto_dir = tmp_path / "channeld_tpu" / "protocol"
+    proto_dir.mkdir(parents=True)
+    (proto_dir / "ghost_pb2.py").write_text(
+        "DESCRIPTOR = POOL.AddSerializedFile(b'')\n")
+    findings = ProtoDriftRule().check_repo(
+        RepoContext(root=str(tmp_path), modules=[]))
+    assert any(f.detector == "orphaned-pb2"
+               and f.path == "channeld_tpu/protocol/ghost_pb2.py"
+               for f in findings)
+
+
+def test_changed_mode_metrics_edit_keeps_cross_file_findings(
+        monkeypatch, capsys, tmp_path):
+    """A core/metrics.py-only change must not filter away the
+    double-entry findings it causes in UNCHANGED files."""
+    import json as _json
+
+    import analyze
+
+    (tmp_path / "scripts").mkdir()
+    core = tmp_path / "channeld_tpu" / "core"
+    core.mkdir(parents=True)
+    (core / "metrics.py").write_text(
+        "from prometheus_client import Counter\n"
+        "sheds = Counter('sheds', 'x', ['reason'])\n")
+    (core / "user.py").write_text(
+        "from . import metrics\n"
+        "def f():\n"
+        "    metrics.sheds.labels(cause='x').inc()\n")  # stale label
+    monkeypatch.setattr(
+        analyze, "changed_files",
+        lambda repo: {"channeld_tpu/core/metrics.py"})
+    rc = analyze.main(["--changed", "--json", "--repo", str(tmp_path),
+                       "--baseline", str(tmp_path / "none.json")])
+    out = _json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert any(f["rule"] == "double-entry"
+               and f["path"] == "channeld_tpu/core/user.py"
+               for f in out["findings"])

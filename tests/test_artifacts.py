@@ -79,3 +79,72 @@ def test_global_soak_dirty_census_is_flagged(tmp_path):
     (tmp_path / "SOAK_GLOBAL_r99.json").write_text(json.dumps(doc))
     errors = check_artifacts.check_artifacts(str(tmp_path))
     assert any("missing invariant check" in e for e in errors)
+
+
+def test_artifact_metric_refs_are_checked():
+    """Committed artifacts citing metrics must cite registered families
+    with the declared label sets (scripts/check_artifacts.py
+    check_artifact_metrics)."""
+    assert check_artifacts.check_artifact_metrics() == []
+
+
+def test_doc_metric_label_set_mismatch_is_flagged():
+    """The label-set validation actually validates: a doc citing a
+    label the declaration does not carry (or a label VALUE where the
+    label NAME belongs) is drift."""
+    names = {"overload_sheds", "tick_stage_ms"}
+    label_sets = {"overload_sheds": {"reason"}, "tick_stage_ms": {"stage"}}
+    errors = check_artifacts._check_metric_refs(
+        "doc/x.md", set(),
+        [("overload_sheds_total", "cause"),       # wrong label name
+         ("tick_stage_ms", "trunk"),              # label value, not name
+         ("overload_sheds_total", 'reason="handover_defer"')],  # ok
+        names, label_sets,
+    )
+    assert len(errors) == 2
+    assert any("overload_sheds" in e and "['cause']" in e for e in errors)
+    assert any("tick_stage_ms" in e and "['trunk']" in e for e in errors)
+
+
+def test_artifact_braced_metric_ref_with_bad_label_is_flagged(tmp_path):
+    import json
+
+    (tmp_path / "SOAK_r99.json").write_text(json.dumps({
+        "kind": "chaos_soak", "scenario": {}, "stats": {},
+        "duration_s": 1, "invariants": {"ok": True, "checks": []},
+        "note": 'ledger matches overload_sheds_total{cause}',
+    }))
+    errors = check_artifacts.check_artifact_metrics(str(tmp_path))
+    assert any("overload_sheds" in e and "['cause']" in e for e in errors)
+
+
+def test_doc_metric_exposition_pairs_accepted():
+    """name{label=\"value\"} exposition-style refs resolve to the label
+    NAME (the doc/federation.md fix this check forced stays fixed)."""
+    assert check_artifacts._parse_ref_labels('trigger="handover_abort"') \
+        == {"trigger"}
+    assert check_artifacts._parse_ref_labels("cell,direction") \
+        == {"cell", "direction"}
+
+
+def test_artifact_quoted_exposition_ref_is_validated(tmp_path):
+    """Exposition-style refs with JSON-escaped quoted values
+    (backend=\\"host\\") are parsed and validated — a quoted ref with a
+    stale label name is flagged, a correct one passes."""
+    import json
+
+    base = {
+        "kind": "chaos_soak", "scenario": {}, "stats": {},
+        "duration_s": 1, "invariants": {"ok": True, "checks": []},
+    }
+    good = dict(base, note='feeds fanout_decision_latency_seconds'
+                           '{backend="host"}')
+    (tmp_path / "SOAK_r98.json").write_text(json.dumps(good))
+    assert check_artifacts.check_artifact_metrics(str(tmp_path)) == []
+
+    bad = dict(base, note='feeds fanout_decision_latency_seconds'
+                          '{chip="host"}')
+    (tmp_path / "SOAK_r98.json").write_text(json.dumps(bad))
+    errors = check_artifacts.check_artifact_metrics(str(tmp_path))
+    assert any("fanout_decision_latency_seconds" in e and "['chip']" in e
+               for e in errors)
