@@ -29,6 +29,11 @@ HOT_PATHS: tuple[tuple[str, str], ...] = (
     ("channeld_tpu/spatial/tpu_controller.py",
      r"^(tick|_apply_follow_interests|_publish_due|_reap_followers|"
      r"device_due)$"),
+    # The supervised step wraps the per-tick device readbacks; its ONE
+    # designed batched fetch (worker-thread _step_body) carries reasoned
+    # disables, everything else in the guard must stay transfer-free.
+    ("channeld_tpu/core/device_guard.py",
+     r"^(run_step|_step_body|_sentinel|_dispatch)$"),
     ("channeld_tpu/spatial/grid.py", r"^_orchestrate"),
     ("channeld_tpu/spatial/controller.py", r"^tick$"),
     ("channeld_tpu/core/channel.py",

@@ -43,8 +43,12 @@ POINTS = {
     "kcp.loss": "drop an outbound datagram",
     "kcp.reorder": "hold an outbound datagram until after the next one",
     "kcp.dup": "duplicate an outbound datagram",
-    # device plane (spatial/tpu_controller.py)
+    # device plane (spatial/tpu_controller.py + core/device_guard.py)
     "device.dispatch_stall": "stall before the engine step (slow device dispatch)",
+    "device.step_error": "raise a transient XLA-style error from the guarded step",
+    "device.step_hang": "stall INSIDE the guarded step past the watchdog deadline",
+    "device.nan": "corrupt device state (NaN positions + garbage cell baselines)",
+    "device.rebuild_fail": "fail the in-process engine rebuild attempt",
     # federation trunk plane (federation/trunk.py)
     "trunk.egress_drop": "drop an outbound trunk frame (lossy inter-gateway link)",
     "trunk.sever": "abort the trunk socket before the write (link partition)",

@@ -292,6 +292,46 @@ shard_replica_entities = Gauge(
     registry=registry,
 )
 
+# Device supervision & in-process engine recovery (core/device_guard.py;
+# doc/device_recovery.md).
+device_state = Gauge(
+    "device_state",
+    "Device-engine supervision state (0 active, 1 degraded: transient "
+    "step failure retrying with backoff, 2 rebuilding: fatal failure, "
+    "in-process rebuild from the host shadow in progress, 3 failed: "
+    "the rebuild itself failed, retrying on a backoff). Anything "
+    "non-zero means device-dependent work is held and the overload "
+    "ladder is pinned to L2+",
+    registry=registry,
+)
+device_recoveries = Counter(
+    "device_recoveries",
+    "Device-engine recoveries completed, by the failure cause that "
+    "triggered them (transient: a retried step succeeded without a "
+    "rebuild; step_error: retries exhausted, engine rebuilt; hang: the "
+    "watchdog deadline expired, engine rebuilt; corruption: the "
+    "readback sentinel caught impossible values, engine rebuilt). The "
+    "python ledger in core/device_guard.py must match exactly",
+    ["cause"],
+    registry=registry,
+)
+device_step_failures = Counter(
+    "device_step_failures",
+    "Guarded device-step failures observed, by cause (step_error / "
+    "hang / corruption / rebuild_fail); every transient retry counts, "
+    "so this moves faster than device_recoveries_total",
+    ["cause"],
+    registry=registry,
+)
+device_rebuild_ms = Histogram(
+    "device_rebuild_ms",
+    "Duration of one in-process engine rebuild (host-shadow re-seed + "
+    "warmup + bit-identical verification), milliseconds",
+    buckets=(5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0,
+             5000.0),
+    registry=registry,
+)
+
 # Overload-control plane (core/overload.py; doc/overload.md).
 overload_level = Gauge(
     "overload_level",
@@ -349,6 +389,8 @@ trace_dumps = Counter(
     "ladder moved; handover_abort: a cross-gateway batch aborted; "
     "migration_abort: a balancer cell migration rolled back; "
     "failover_epoch: a dead server's cells were re-hosted; "
+    "device_failure: the device engine failed fatally and is "
+    "rebuilding in-process; "
     "manual/sigusr2/shutdown: explicit dump_trace calls). Anomaly "
     "triggers count even when the dump itself was suppressed by the "
     "cooldown; a disabled recorder (-trace false) counts nothing",

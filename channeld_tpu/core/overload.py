@@ -108,6 +108,11 @@ class OverloadGovernor:
         self._up_ticks = 0
         self._down_since: Optional[float] = None
         self._last_down_at = -1e9  # anti-flap cooldown anchor
+        # Emergency level floor (core/device_guard.py): while the device
+        # engine is down the ladder is pinned at/above this level —
+        # shedding outranks a dead engine (doc/device_recovery.md).
+        self._level_floor = 0
+        self._floor_reason = ""
         self._started = time.monotonic()
         self._publish_level()
 
@@ -153,6 +158,27 @@ class OverloadGovernor:
         for cid, s in cost.items():
             pressure[cid] = alpha * (s / interval)
         cost.clear()
+
+    # ---- emergency level floor (device guard) ----------------------------
+
+    def pin_floor(self, level: int, reason: str) -> None:
+        """Pin the ladder at/above ``level`` until released. Unlike the
+        normal one-step-per-tick discipline this jumps immediately — a
+        dead device engine IS an emergency, and shedding outranks it
+        (doc/device_recovery.md). A no-op while the governor is
+        disabled (the operator pinned L0 on purpose)."""
+        self._level_floor = int(level)
+        self._floor_reason = reason
+        if (global_settings.overload_enabled
+                and self.level < self._level_floor):
+            self._move(self._level_floor, forced=True)
+
+    def release_floor(self) -> None:
+        """Drop the emergency floor; the ladder de-escalates through the
+        normal hysteresis (down-hold, one step per tick) so the release
+        itself cannot flap service levels."""
+        self._level_floor = 0
+        self._floor_reason = ""
 
     # ---- the update (once per GLOBAL tick) -------------------------------
 
@@ -231,6 +257,12 @@ class OverloadGovernor:
                 self._move(level + 1)
         elif level > OverloadLevel.L0 and self.pressure < exit_[level - 1]:
             self._up_ticks = 0
+            if level - 1 < self._level_floor:
+                # Emergency floor (device engine down): hold here no
+                # matter how calm the pressure looks — the calm is the
+                # held device work, not spare capacity.
+                self._down_since = None
+                return
             if self._down_since is None:
                 self._down_since = now
             elif now - self._down_since >= st.overload_down_hold_s:

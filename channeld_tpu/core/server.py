@@ -446,6 +446,113 @@ async def flush_loop(interval: float = 0.001) -> None:
         await asyncio.sleep(interval)
 
 
+async def drain_gateway(listeners: Optional[list] = None) -> dict:
+    """Graceful SIGTERM drain (doc/device_recovery.md): stop accepting,
+    park every client with a structured ``ServerBusyMessage`` (they back
+    off ``overload_retry_after_ms`` and reconnect — to this gateway
+    post-restart, or wherever a redirect points them), say goodbye on
+    every live trunk so the control-plane leader re-maps this shard
+    immediately instead of waiting out ``global_death_miss_epochs``, and
+    write a final fsync'd snapshot through the shared ``write_snapshot``
+    path. Returns a small report (tested directly; the SIGTERM handler
+    is just this plus process exit)."""
+    from .connection import all_connections
+    from .message import MessageContext
+    from .overload import governor
+    from .types import MessageType
+    from ..protocol import control_pb2
+
+    report = {"clients_parked": 0, "goodbye_peers": 0, "snapshot": ""}
+    logger.warning("SIGTERM: draining gateway (park clients, trunk "
+                   "goodbye, final snapshot)")
+    for srv in listeners or []:
+        try:
+            srv.close()
+        except Exception:
+            pass
+    # Park clients: a structured retry-after, then the socket closes —
+    # the same ServerBusyMessage shape L3 admission refusals use, so
+    # every client library already knows how to honor it.
+    busy = control_pb2.ServerBusyMessage(
+        reason="shutdown",
+        retryAfterMs=global_settings.overload_retry_after_ms,
+        overloadLevel=int(governor.level),
+    )
+    for conn in list(all_connections().values()):
+        if conn.connection_type != ConnectionType.CLIENT:
+            continue
+        if conn.is_closing():
+            continue
+        conn.send(MessageContext(
+            msg_type=MessageType.SERVER_BUSY, msg=busy, channel_id=0,
+        ))
+        conn.flush()
+        report["clients_parked"] += 1
+    for conn in list(all_connections().values()):
+        if conn.connection_type == ConnectionType.CLIENT:
+            conn.close()
+    # Trunk goodbye: peers drop the link now and the leader fast-tracks
+    # the death declaration (federation/control.py on_peer_goodbye).
+    if global_settings.federation_config:
+        from ..federation import plane as fed_plane
+
+        if fed_plane.active:
+            report["goodbye_peers"] = fed_plane.announce_goodbye()
+    # Final snapshot LAST, after the parks above stopped mutating
+    # subscriber state: fsync-then-rename, so a kill -9 racing this
+    # drain still leaves a consistent file.
+    if global_settings.snapshot_path:
+        from .snapshot import take_snapshot, write_snapshot
+
+        try:
+            snap = take_snapshot()
+            await asyncio.to_thread(
+                write_snapshot, snap, global_settings.snapshot_path
+            )
+            report["snapshot"] = global_settings.snapshot_path
+            logger.info("final snapshot of %d channels written to %s",
+                        len(snap.channels), global_settings.snapshot_path)
+        except Exception:
+            logger.exception("final shutdown snapshot failed")
+    logger.warning(
+        "drain complete: %d clients parked, %d trunk peers said goodbye",
+        report["clients_parked"], report["goodbye_peers"],
+    )
+    return report
+
+
+def install_sigterm_drain(listeners: list, tasks: list,
+                          serve_task: Optional[asyncio.Task] = None) -> None:
+    """Wire SIGTERM to the graceful drain; after the drain the serve
+    tasks are cancelled so run_server's gather returns and the process
+    exits through the normal (trace-dump-registered) teardown.
+    ``serve_task`` (run_server's own task) is cancelled too: during the
+    wait-for-master boot phase run_server blocks on the GLOBAL-channel
+    possession event, not on any task in ``tasks`` — without this a
+    SIGTERM in that window would drain and then hang forever, exactly
+    the stuck-boot case where an operator reaches for SIGTERM."""
+    import signal
+
+    def _on_sigterm() -> None:
+        async def _drain_and_exit():
+            try:
+                await drain_gateway(listeners)
+            finally:
+                for t in tasks:
+                    t.cancel()
+                if serve_task is not None and not serve_task.done():
+                    serve_task.cancel()
+
+        asyncio.ensure_future(_drain_and_exit())
+
+    try:
+        asyncio.get_running_loop().add_signal_handler(
+            signal.SIGTERM, _on_sigterm
+        )
+    except (NotImplementedError, RuntimeError):
+        logger.info("SIGTERM drain unavailable on this platform")
+
+
 async def run_server(argv: Optional[list[str]] = None) -> None:
     """Full bootstrap (ref: cmd/main.go:12-56)."""
     global_settings.parse_flags(argv)
@@ -585,24 +692,39 @@ async def run_server(argv: Optional[list[str]] = None) -> None:
             global_settings.snapshot_path, global_settings.snapshot_interval_s
         )))
 
+    listeners: list = []
     try:
-        await start_listening(
+        listeners.append(await start_listening(
             ConnectionType.SERVER,
             global_settings.server_network,
             global_settings.server_address,
-        )
+        ))
     except OSError as e:
         logger.error(
             "cannot listen on %s %s: %s", global_settings.server_network,
             global_settings.server_address, e,
         )
         raise SystemExit(1)
-    if global_settings.client_network_wait_master_server:
-        logger.info("waiting for the GLOBAL channel to be possessed...")
-        await events.global_channel_possessed.wait()
-    await start_listening(
-        ConnectionType.CLIENT,
-        global_settings.client_network,
-        global_settings.client_address,
-    )
-    await asyncio.gather(*tasks)
+    # SIGTERM drains instead of killing mid-tick: final fsync'd
+    # snapshot, clients parked with ServerBusyMessage{retryAfterMs},
+    # trunk goodbye so the shard re-maps immediately
+    # (doc/device_recovery.md). The current task is handed over so a
+    # SIGTERM during the wait-for-master phase below exits instead of
+    # draining into a hang.
+    try:
+        serve_task = asyncio.current_task()
+    except RuntimeError:
+        serve_task = None
+    install_sigterm_drain(listeners, tasks, serve_task)
+    try:
+        if global_settings.client_network_wait_master_server:
+            logger.info("waiting for the GLOBAL channel to be possessed...")
+            await events.global_channel_possessed.wait()
+        listeners.append(await start_listening(
+            ConnectionType.CLIENT,
+            global_settings.client_network,
+            global_settings.client_address,
+        ))
+        await asyncio.gather(*tasks)
+    except asyncio.CancelledError:
+        logger.info("serve tasks cancelled; gateway exiting")

@@ -265,6 +265,28 @@ class GlobalSettings:
     global_migrate_timeout_ms: int = 8000
     global_adopt_claims_timeout_ms: int = 750
 
+    # Device supervision & in-process engine recovery (new —
+    # doc/device_recovery.md). The device step runs under a watchdog:
+    # the guarded step is dispatched to a dedicated worker thread and
+    # the tick waits at most ``device_step_deadline_s`` (the jax call
+    # blocks, so hang detection must be off-thread). Transient step
+    # errors retry with exponential backoff up to ``device_retry_max``
+    # attempts; a hang, a sentinel-detected corruption, or an exhausted
+    # retry budget is FATAL and triggers an in-process engine rebuild
+    # from the host-side shadow (entity registry, query params, sub
+    # intervals, placement ledger), verified bit-identical before the
+    # gateway resumes device service. While the engine is down the
+    # gateway degrades instead of dying: device-dependent work is held
+    # and the overload ladder is pinned to L2+.
+    device_guard_enabled: bool = True
+    device_step_deadline_s: float = 2.0
+    device_retry_max: int = 2
+    device_retry_backoff_ms: int = 100
+    # Operator bound on one full recovery (failure detect -> verified
+    # rebuilt engine serving again); overruns warn and fail soaks — a
+    # slow recovery still beats a dead gateway.
+    device_recovery_deadline_s: float = 10.0
+
     # Flight recorder (new — doc/observability.md). Always-on by
     # default: the recorder is fixed-memory (per-thread span rings) and
     # its hot-path cost is two clock reads + a ring store per tick
@@ -447,6 +469,23 @@ class GlobalSettings:
                        help="consecutive control epochs a trunk must "
                             "stay down before the leader declares the "
                             "gateway dead and re-hosts its shard")
+        p.add_argument("-device-guard",
+                       type=lambda s: s.lower() not in
+                       ("false", "0", "no", "off"),
+                       default=self.device_guard_enabled,
+                       help="device watchdog + in-process engine "
+                            "recovery (doc/device_recovery.md); false "
+                            "runs the device step unguarded")
+        p.add_argument("-device-deadline", type=float,
+                       default=self.device_step_deadline_s,
+                       help="seconds one guarded device step may take "
+                            "before it is declared hung (fatal; the "
+                            "engine rebuilds from the host shadow)")
+        p.add_argument("-device-recovery-deadline", type=float,
+                       default=self.device_recovery_deadline_s,
+                       help="seconds one full device recovery (failure "
+                            "detect -> verified rebuild) may take "
+                            "before the overrun is logged as a warning")
         p.add_argument("-trace",
                        type=lambda s: s.lower() not in
                        ("false", "0", "no", "off"),
@@ -524,6 +563,9 @@ class GlobalSettings:
             self.global_imbalance_exit, args.global_imbalance * 0.85
         )
         self.global_death_miss_epochs = args.global_death_epochs
+        self.device_guard_enabled = args.device_guard
+        self.device_step_deadline_s = args.device_deadline
+        self.device_recovery_deadline_s = args.device_recovery_deadline
         self.trace_enabled = args.trace
         self.trace_ring_spans = args.trace_ring
         self.trace_dump_ticks = args.trace_dump_ticks

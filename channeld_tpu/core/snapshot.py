@@ -11,6 +11,7 @@ servers reconnect.
 
 from __future__ import annotations
 
+import itertools
 import os
 import time
 from typing import Optional
@@ -52,17 +53,31 @@ def take_snapshot() -> snapshot_pb2.GatewaySnapshot:
     return snap
 
 
+_tmp_seq = itertools.count()
+
+
 def write_snapshot(snap: snapshot_pb2.GatewaySnapshot, path: str) -> str:
     """Durable write: tmp file, fsync, then atomic rename — a crash at
     any point leaves either the old snapshot or the new one, never a
-    torn file. Shared by the one-shot save and the periodic loop."""
+    torn file. Shared by the one-shot save, the periodic loop, the
+    shutdown drain, and the device guard's fatal/recovery snapshots.
+    The tmp name is writer-unique: the guard legitimately schedules two
+    off-thread writes back-to-back (fatal then recovered), and a shared
+    ``.tmp`` would let one writer rename the other's file out from
+    under it."""
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(snap.SerializeToString())
-        f.flush()
-        os.fsync(f.fileno())  # data durable before the rename lands
-    os.replace(tmp, path)  # atomic
+    tmp = f"{path}.tmp.{os.getpid()}.{next(_tmp_seq)}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(snap.SerializeToString())
+            f.flush()
+            os.fsync(f.fileno())  # data durable before the rename lands
+        os.replace(tmp, path)  # atomic
+    finally:
+        try:
+            os.remove(tmp)  # only survives when the replace never ran
+        except OSError:
+            pass
     return path
 
 

@@ -191,6 +191,10 @@ class GlobalControlPlane:
         # peer -> consecutive epochs its reported directory version
         # trailed ours (leader-side; >= 3 triggers a replace re-sync).
         self._behind_streak: dict[str, int] = {}
+        # Peers that announced a graceful-shutdown goodbye: the death
+        # declaration skips the miss window for them (the silence is
+        # intentional, not ambiguous; doc/device_recovery.md).
+        self._goodbyes: set[str] = set()
         self._crossings_acc = 0
         self._crossing_rate = 0.0
         # Python-side ledgers; must match global_migrations_total{result}
@@ -285,6 +289,8 @@ class GlobalControlPlane:
     def on_trunk_up(self, peer: str) -> None:
         self._seen_up.add(peer)
         self._down_since.pop(peer, None)
+        # A returning peer supersedes any earlier goodbye (it restarted).
+        self._goodbyes.discard(peer)
         if peer in self.dead:
             # A declared-dead gateway reconnected (it was partitioned,
             # not crashed). Its shard has been adopted; sync it the
@@ -316,6 +322,21 @@ class GlobalControlPlane:
     def on_trunk_down(self, peer: str) -> None:
         if self.active and peer in self._seen_up:
             self._down_since.setdefault(peer, time.monotonic())
+
+    def on_peer_goodbye(self, peer: str) -> None:
+        """The peer sent a graceful-shutdown farewell: its trunk silence
+        is intentional, so the leader declares the death at the NEXT
+        epoch tick instead of waiting out global_death_miss_epochs —
+        the shard re-maps in one epoch and clients redirect instead of
+        timing out against a corpse."""
+        if not self.active or peer in self.dead:
+            return
+        self._goodbyes.add(peer)
+        self._event({"kind": "peer_goodbye", "peer": peer})
+        logger.warning(
+            "gateway %s said goodbye (graceful shutdown); death "
+            "declaration fast-tracked", peer,
+        )
 
     def _sync_directory(self, peer: str) -> None:
         """Full-map replace sync to one returned gateway. If the
@@ -1285,6 +1306,10 @@ class GlobalControlPlane:
             if peer not in self._seen_up:
                 continue  # never had a trunk: boot, not death
             t0 = self._down_since.setdefault(peer, now)
+            if peer in self._goodbyes:
+                # Graceful goodbye: the silence is announced, not
+                # ambiguous — skip the miss window entirely.
+                t0 = now - window_s
             # Only the leader declares — computed EXCLUDING the suspect
             # (a dead lowest-id gateway must not stay leader forever).
             survivors = [
@@ -1375,6 +1400,7 @@ class GlobalControlPlane:
         metrics.gateway_deaths.inc()
         self.vectors.pop(dead, None)
         self._down_since.pop(dead, None)
+        self._goodbyes.discard(dead)
         # A drain whose DESTINATION just died can never complete: the
         # leader reverts the cell to us, and without this cancel the
         # drain would park/drop-churn its residents every epoch until

@@ -174,6 +174,27 @@ class FederationPlane:
             directory.local_server_indices(), directory.peers(),
         )
 
+    def announce_goodbye(self) -> int:
+        """Graceful-shutdown farewell on every live trunk: peers take
+        the link down immediately and the control-plane leader
+        re-maps this gateway's shard without waiting out the death-miss
+        window (core/server.py drain_gateway). Returns how many peers
+        heard it."""
+        heard = 0
+        for peer in directory.peers():
+            link = self.link_to(peer)
+            if link is not None and link.send(
+                MessageType.TRUNK_HEARTBEAT,
+                control_pb2.TrunkHeartbeatMessage(
+                    sentAtMs=int(time.monotonic() * 1000.0),
+                    goodbye=True,
+                ),
+            ):
+                heard += 1
+        if heard:
+            self._event({"kind": "goodbye_sent", "peers": heard})
+        return heard
+
     def stop(self) -> None:
         self.active = False
         global_control.stop()
@@ -902,6 +923,12 @@ class FederationPlane:
                 )
         elif msg_type == MessageType.TRUNK_HELLO:
             pass  # re-hello after establishment: harmless
+        elif msg_type == MessageType.TRUNK_HEARTBEAT:
+            # Only goodbye heartbeats are forwarded by the link
+            # (ordinary liveness probes are handled inside TrunkLink):
+            # the peer is draining gracefully — the control plane skips
+            # the death-miss window for it.
+            global_control.on_peer_goodbye(peer)
         elif MessageType.TRUNK_LOAD_REPORT <= msg_type \
                 <= MessageType.TRUNK_ADOPT_CLAIMS:
             # Global-control traffic (38-45): channel mutations, so it

@@ -216,6 +216,17 @@ class TrunkLink:
     def _on_heartbeat(self, msg) -> None:
         from ..core import metrics
 
+        if msg.goodbye:
+            # Graceful-shutdown farewell: the peer is draining on
+            # purpose. Surface it to the plane (the control-plane
+            # leader fast-tracks the death declaration) and take the
+            # link down NOW — in-flight handovers toward the dying
+            # peer abort deterministically through the ordinary
+            # trunk-down path instead of churning until timeout.
+            self._on_message(self.peer_id, int(MessageType.TRUNK_HEARTBEAT),
+                             msg)
+            self._go_down("peer goodbye (graceful shutdown)")
+            return
         if msg.ack:
             rtt_ms = time.monotonic() * 1000.0 - msg.sentAtMs
             if 0 <= rtt_ms < 60_000:

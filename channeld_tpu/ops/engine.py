@@ -149,6 +149,18 @@ class SpatialEngine:
 
         self._start = time.monotonic()
         self.last_result: Optional[dict] = None
+        # Abandoned-step fence (core/device_guard.py): the watchdog bumps
+        # this when it gives up on a hung step; a zombie worker thread
+        # completing the old tick later must not commit its tail state
+        # over a rebuilt engine (tick() re-checks before committing).
+        self.generation = 0
+        # Serializes concurrent rebuild bodies (a watchdog-abandoned
+        # rebuild's worker vs its retry on a fresh worker): the stale
+        # one must never interleave transfers with — or commit over —
+        # the live one. See device_guard._rebuild_body.
+        import threading
+
+        self._rebuild_lock = threading.Lock()
         # Fused Mosaic assign+count on TPU backends (pallas_kernels);
         # the sharded step uses plain XLA inside shard_map.
         from .pallas_kernels import pallas_available
@@ -327,37 +339,58 @@ class SpatialEngine:
             return arr
         return jax.device_put(arr, self._entity_ns)
 
-    def _flush_host_state(self) -> None:
+    def _flush_host_state(self, expect_generation: Optional[int] = None) -> None:
+        def _fence() -> None:
+            # Stale-tick fence (core/device_guard.py): a watchdog-
+            # abandoned worker that unwedges mid-flush must not commit
+            # staged arrays over a rebuilt engine. Each block stages
+            # its device work into locals and re-checks the generation
+            # immediately before the engine-visible assignment, so the
+            # exposure shrinks from the whole flush to one store.
+            if (expect_generation is not None
+                    and expect_generation != self.generation):
+                raise RuntimeError("stale device tick abandoned by watchdog")
+
+        _fence()
         if self._dirty_slots:
             idx = np.fromiter(self._dirty_slots, np.int32, len(self._dirty_slots))
-            self._d_positions = self._keep_entity_sharding(
+            d_positions = self._keep_entity_sharding(
                 self._d_positions.at[idx].set(self._positions[idx])
             )
-            self._d_valid = self._keep_entity_sharding(
+            d_valid = self._keep_entity_sharding(
                 self._d_valid.at[idx].set(self._valid[idx])
             )
+            _fence()
+            self._d_positions = d_positions
+            self._d_valid = d_valid
             self._dirty_slots.clear()
         if self._seed_cells:
             slots = np.fromiter(self._seed_cells.keys(), np.int32, len(self._seed_cells))
             cells = np.fromiter(self._seed_cells.values(), np.int32, len(self._seed_cells))
-            self._d_cell = self._keep_entity_sharding(
+            d_cell = self._keep_entity_sharding(
                 self._d_cell.at[slots].set(cells)
             )
+            _fence()
+            self._d_cell = d_cell
             self._seed_cells.clear()
         spots_changed = False
         if self._q_spot_dist is not None:
             if self._d_spot_dist is None:
                 # .copy(): async H2D vs later host row writes (below).
-                self._d_spot_dist = jnp.asarray(self._q_spot_dist.copy())
+                d_spot = jnp.asarray(self._q_spot_dist.copy())
+                _fence()
+                self._d_spot_dist = d_spot
                 self._spot_dirty_rows.clear()
                 spots_changed = True
             elif self._spot_dirty_rows:
                 idx = np.fromiter(
                     self._spot_dirty_rows, np.int32, len(self._spot_dirty_rows)
                 )
-                self._d_spot_dist = self._d_spot_dist.at[idx].set(
+                d_spot = self._d_spot_dist.at[idx].set(
                     self._q_spot_dist[idx]
                 )
+                _fence()
+                self._d_spot_dist = d_spot
                 self._spot_dirty_rows.clear()
                 spots_changed = True
         if self._d_queries is None or self._queries_dirty or spots_changed:
@@ -367,7 +400,7 @@ class SpatialEngine:
             # so handing jax the live buffer races host writes against
             # the deferred copy (observed on a loaded host as a query
             # table whose slot read as cleared one tick early).
-            self._d_queries = QuerySet(
+            d_queries = QuerySet(
                 jnp.asarray(self._q_kind.copy()),
                 jnp.asarray(self._q_center.copy()),
                 jnp.asarray(self._q_extent.copy()),
@@ -375,14 +408,18 @@ class SpatialEngine:
                 jnp.asarray(self._q_angle.copy()),
                 self._d_spot_dist,
             )
+            _fence()
+            self._d_queries = d_queries
             self._queries_dirty = False
         if self._d_sub_state is None:
             # .copy(): async H2D vs later host writes to these mirrors.
-            self._d_sub_state = (
+            d_sub = (
                 jnp.asarray(self._sub_last.copy()),
                 jnp.asarray(self._sub_interval.copy()),
                 jnp.asarray(self._sub_active.copy()),
             )
+            _fence()
+            self._d_sub_state = d_sub
             self._sub_dirty_slots.clear()
             self._sub_last_dirty.clear()
         elif self._sub_dirty_slots or self._sub_last_dirty:
@@ -390,20 +427,24 @@ class SpatialEngine:
             # device's last-fan-out values for untouched slots stay
             # authoritative (fanout_due advances them device-side).
             last, interval, active = self._d_sub_state
+            last_idx = sub_idx = None
             if self._sub_last_dirty:
-                idx = np.fromiter(
+                last_idx = np.fromiter(
                     self._sub_last_dirty, np.int32, len(self._sub_last_dirty)
                 )
-                last = last.at[idx].set(self._sub_last[idx])
-                self._sub_last_dirty.clear()
+                last = last.at[last_idx].set(self._sub_last[last_idx])
             if self._sub_dirty_slots:
-                idx = np.fromiter(
+                sub_idx = np.fromiter(
                     self._sub_dirty_slots, np.int32, len(self._sub_dirty_slots)
                 )
-                interval = interval.at[idx].set(self._sub_interval[idx])
-                active = active.at[idx].set(self._sub_active[idx])
-                self._sub_dirty_slots.clear()
+                interval = interval.at[sub_idx].set(self._sub_interval[sub_idx])
+                active = active.at[sub_idx].set(self._sub_active[sub_idx])
+            _fence()
             self._d_sub_state = (last, interval, active)
+            if last_idx is not None:
+                self._sub_last_dirty.clear()
+            if sub_idx is not None:
+                self._sub_dirty_slots.clear()
 
     def warmup(self) -> None:
         """Compile the tick's common (no-spots) step on empty tables —
@@ -420,7 +461,11 @@ class SpatialEngine:
         """Run one device decision pass; returns numpy-backed results."""
         if now_ms is None:
             now_ms = self.now_ms()
-        self._flush_host_state()
+        gen = self.generation
+        # The flush carries the fence too: its staged commits are the
+        # other place a watchdog-abandoned worker could write stale
+        # arrays over a rebuilt engine (see _flush_host_state).
+        self._flush_host_state(expect_generation=gen)
         if self._mesh is not None:
             out = self._mesh_tick(now_ms)
         else:
@@ -435,6 +480,11 @@ class SpatialEngine:
                 jnp.int32(now_ms),
                 use_pallas=self.use_pallas,
             )
+        if gen != self.generation:
+            # The watchdog abandoned this step (device_guard): the
+            # engine may already be rebuilt — committing this tick's
+            # tail state would corrupt the fresh baseline.
+            raise RuntimeError("stale device tick abandoned by watchdog")
         # Baseline for the next tick: crossings that overflowed the handover
         # row budget keep their old cell so they are re-detected, not lost.
         self._d_cell = out["committed_prev"]
@@ -563,3 +613,153 @@ class SpatialEngine:
             drow = dist[q]
             out[cid] = {int(c): int(drow[c]) for c in cells}
         return out
+
+    # ---- supervision & recovery (core/device_guard.py) -------------------
+
+    def tracked_entities(self) -> list[tuple[int, int]]:
+        """[(entity_id, slot)] for every live registration — what the
+        device guard walks to compute per-slot rebuild baselines."""
+        return list(self._slot_of_entity.items())
+
+    def bump_generation(self) -> None:
+        """Fence off an abandoned (hung) step: a zombie worker thread
+        finishing the old tick later raises instead of committing its
+        tail state over whatever the guard rebuilt meanwhile."""
+        self.generation += 1
+
+    def rebuild_device_state(self, slot_cells: dict[int, int],
+                             now_ms: Optional[int] = None,
+                             expect_generation: Optional[int] = None) -> None:
+        """In-process device-state rebuild from the host-side shadow
+        (doc/device_recovery.md). The host mirrors are authoritative for
+        everything except two device-advanced columns:
+
+        - the per-slot *previous cell* baseline, which the caller passes
+          in as ``slot_cells`` (computed from the grid's ``_data_cell``
+          placement ledger + the failover journal's in-flight dsts, so a
+          mid-crossing entity re-baselines to where its data is actually
+          bound — the next tick re-detects any move since);
+        - the sub table's last-fan-out column, which is snapped to
+          ``now``: every sub's window restarts, so fan-out resumes one
+          full interval from the rebuild instead of bursting or
+          silently slipping.
+
+        Everything device-side is re-created from fresh copies; nothing
+        the corrupted arrays held survives.
+
+        ``expect_generation``: the caller's stale-rebuild fence — the
+        fresh arrays are built FIRST (the wedge-prone blocking
+        transfers), and nothing engine-visible mutates unless the
+        generation still matches. A rebuild the watchdog abandoned
+        (which bumped the generation) raises here when it unwedges
+        instead of committing stale state over a later verified one."""
+        if now_ms is None:
+            now_ms = self.now_ms()
+        if expect_generation is None:
+            expect_generation = self.generation
+        cells = np.full(self.entity_capacity, -1, np.int32)
+        for slot, cell in slot_cells.items():
+            cells[slot] = cell
+        if self._entity_ns is not None:
+            d_positions = jax.device_put(
+                self._positions.copy(), self._entity_ns
+            )
+            d_valid = jax.device_put(self._valid.copy(), self._entity_ns)
+            d_cell = jax.device_put(cells.copy(), self._entity_ns)
+        else:
+            d_positions = jnp.asarray(self._positions.copy())
+            d_valid = jnp.asarray(self._valid.copy())
+            d_cell = jnp.asarray(cells.copy())
+        if expect_generation != self.generation:
+            raise RuntimeError("stale rebuild abandoned by watchdog")
+        self.generation += 1
+        self._d_positions = d_positions
+        self._d_valid = d_valid
+        self._d_cell = d_cell
+        self._dirty_slots.clear()
+        self._seed_cells.clear()
+        # Query tables: host staging is fully authoritative; force a
+        # wholesale re-upload (the spots table re-uploads from scratch
+        # on the next flush when present).
+        self._d_queries = None
+        self._d_spot_dist = None
+        self._spot_dirty_rows.clear()
+        self._queries_dirty = True
+        # Sub table: intervals/active from the host mirror; the
+        # device-authoritative last-fan-out column restarts at now.
+        self._sub_last[self._sub_active] = now_ms
+        self._d_sub_state = None
+        self._sub_dirty_slots.clear()
+        self._sub_last_dirty.clear()
+        self._flush_host_state()
+        self.last_result = None
+
+    def verify_device_state(self, slot_cells: dict[int, int]) -> list[str]:
+        """Bit-identical rebuild verification: fetch the just-rebuilt
+        device arrays and compare them against the host shadow (and the
+        seeded cell baselines). Returns mismatch descriptions (empty ==
+        verified). Rebuild-path only — never called from the tick, so
+        these transfers are the designed one-off recovery cost, not a
+        hot-path readback."""
+        errors: list[str] = []
+        cells = np.full(self.entity_capacity, -1, np.int32)
+        for slot, cell in slot_cells.items():
+            cells[slot] = cell
+        # equal_nan on the float arrays: NaN coordinates are tolerated
+        # input (they assign outside the world) and round-trip the
+        # device bit-identically — without this, one NaN position would
+        # fail verification forever and turn a recoverable fault into a
+        # permanent outage.
+        if not np.array_equal(np.asarray(self._d_positions), self._positions,
+                              equal_nan=True):
+            errors.append("positions differ from host shadow")
+        if not np.array_equal(np.asarray(self._d_valid), self._valid):
+            errors.append("valid mask differs from host shadow")
+        if not np.array_equal(np.asarray(self._d_cell), cells):
+            errors.append("cell baselines differ from placement seeds")
+        if self._d_queries is not None:
+            for name, dev, host, has_nan in (
+                ("query kinds", self._d_queries.kind, self._q_kind, False),
+                ("query centers", self._d_queries.center, self._q_center,
+                 True),
+                ("query extents", self._d_queries.extent, self._q_extent,
+                 True),
+            ):
+                if not np.array_equal(np.asarray(dev), host,
+                                      equal_nan=has_nan):
+                    errors.append(f"{name} differ from host shadow")
+        if self._d_sub_state is not None:
+            last, interval, active = self._d_sub_state
+            if not np.array_equal(np.asarray(interval), self._sub_interval):
+                errors.append("sub intervals differ from host shadow")
+            if not np.array_equal(np.asarray(active), self._sub_active):
+                errors.append("sub active mask differs from host shadow")
+            if not np.array_equal(np.asarray(last), self._sub_last):
+                errors.append("sub clock differs from rebuild seed")
+        return errors
+
+    def corrupt_device_state_for_chaos(self) -> None:
+        """CHAOS ONLY (``device.nan``): silently rot the device state the
+        way a bad DMA / bit-flipped HBM page would — NaN positions plus
+        garbage prev-cell baselines. The NaN positions make the affected
+        entities vanish from cell assignment (assign_cells maps NaN
+        outside the world); the garbage baselines surface as impossible
+        src cells in the next tick's handover rows, which is exactly the
+        signature the readback sentinel checks for."""
+        live = list(self._slot_of_entity.values())
+        n = max(1, len(live) // 4)
+        # Garbage baselines on one subset: their (still-valid) positions
+        # produce crossing rows with an impossible src cell next tick —
+        # the sentinel's detectable signature. NaN positions on a
+        # DISJOINT subset: those entities silently vanish from cell
+        # assignment (NaN maps outside the world), the truly silent rot
+        # the sentinel-triggered rebuild also heals.
+        garbage = np.fromiter(live[:n], np.int32, min(n, len(live)))
+        nan_rows = np.fromiter(live[n:2 * n], np.int32, len(live[n:2 * n]))
+        self._d_cell = self._keep_entity_sharding(
+            self._d_cell.at[garbage].set(1 << 24)
+        )
+        if len(nan_rows):
+            self._d_positions = self._keep_entity_sharding(
+                self._d_positions.at[nan_rows].set(float("nan"))
+            )

@@ -24,6 +24,7 @@ from __future__ import annotations
 from typing import Callable, Optional
 
 from ..chaos.injector import chaos as _chaos
+from ..core.device_guard import guard as _guard
 from ..core.failover import journal as _journal
 from ..core.overload import governor as _governor
 from .balancer import balancer as _balancer
@@ -269,6 +270,49 @@ class TPUSpatialController(StaticGrid2DSpatialController):
     # placement ledger lives on the base grid controller now (host
     # gateways need the same exactness; doc/global_control.md).
 
+    # ---- device supervision hooks (core/device_guard.py) -----------------
+
+    def on_device_fatal(self, cause: str) -> None:
+        """The engine just failed fatally. Deferred crossings came from
+        a possibly-corrupt engine AND will be re-detected from the
+        rebuilt baseline anyway (each entity's data stays in its last
+        orchestrated cell; the reseed makes the next tick re-report any
+        move since) — dropping them here is lossless and deterministic.
+        In-flight journal transactions are host-side channel hops that
+        complete on their own; the rebuild seeding honors them via
+        ``pending_dst`` (doc/device_recovery.md)."""
+        if self._deferred_crossings:
+            logger.warning(
+                "device %s: dropping %d deferred crossings (re-detected "
+                "after rebuild)", cause, len(self._deferred_crossings),
+            )
+            self._deferred_crossings.clear()
+
+    def rebuild_seed_cells(self) -> dict[int, int]:
+        """{engine slot: cell index} baselines for the in-process engine
+        rebuild — where each entity's channel data authoritatively
+        lives right now. The failover journal's in-flight dst outranks
+        the committed ``_data_cell`` ledger (mid-flight, the data is
+        bound for the pending dst); entities with neither fall back to
+        their last known position (first sighting that never
+        orchestrated). The rebuilt engine re-detects any movement since
+        from these baselines, so an outage never loses a crossing."""
+        start = global_settings.spatial_channel_id_start
+        seeds: dict[int, int] = {}
+        for entity_id, slot in self.engine.tracked_entities():
+            ch_id = _journal.pending_dst(entity_id)
+            if ch_id is None:
+                ch_id = self._data_cell.get(entity_id)
+            if ch_id is None:
+                info = self._last_positions.get(entity_id)
+                if info is not None:
+                    try:
+                        ch_id = self.get_channel_id(info)
+                    except ValueError:
+                        ch_id = None
+            seeds[slot] = (ch_id - start) if ch_id is not None else -1
+        return seeds
+
     # ---- device fan-out plane --------------------------------------------
 
     def device_sub_add(
@@ -446,7 +490,18 @@ class TPUSpatialController(StaticGrid2DSpatialController):
             stall = _chaos.stall_s("device.dispatch_stall")
             if stall:
                 _time.sleep(stall)
-        result = self.engine.tick()
+        if _guard.enabled:
+            # Supervised step (doc/device_recovery.md): watchdog +
+            # transient retry + sentinel + in-process rebuild. None =
+            # the engine is down/held this tick — every device-
+            # dependent stage below (due publish, crossing
+            # orchestration, follower pass) waits; host-side work
+            # (server reaping, follower registry upkeep) already ran.
+            result = _guard.run_step(self)
+            if result is None:
+                return
+        else:
+            result = self.engine.tick()
         handovers = self.engine.handover_list(result)
         metrics.tpu_step_latency.observe(_time.monotonic() - t0)
         # Same window as tpu_step_latency: dispatch + device step + the

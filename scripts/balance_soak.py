@@ -188,6 +188,11 @@ async def run_balance_soak(p: BalanceSoakParams) -> dict:
     # recording and anomaly auto-dumps must not perturb either
     # (scripts/trace_soak.py is the recorder's own soak).
     global_settings.trace_enabled = False
+    # Device guard pinned OFF (doc/device_recovery.md): this soak's
+    # envelope is deterministic; the watchdog worker-thread hop and
+    # any chaos-adjacent retry would perturb it. The device plane's
+    # own soak is scripts/device_soak.py.
+    global_settings.device_guard_enabled = False
     from channeld_tpu.core.tracing import recorder as _flight_recorder
 
     _flight_recorder.configure(enabled=False)

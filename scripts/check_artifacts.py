@@ -55,6 +55,12 @@ SCHEMAS: dict[str, set] = {
     "SOAK_GLOBAL_*.json": _SOAK_KEYS | {
         "migration", "adoption", "redirect", "census",
     },
+    # Device supervision soak (doc/device_recovery.md acceptance
+    # artifact): the guard's recovery ledger, the census, and the
+    # bounded-recovery numbers the doc cites.
+    "SOAK_DEVICE_*.json": _SOAK_KEYS | {
+        "device", "recoveries", "census", "scenario", "stats",
+    },
     # Flight-recorder soak (doc/observability.md acceptance artifact).
     "TRACE_*.json": _SOAK_KEYS | {
         "stages", "anomaly_dumps", "cross_gateway", "overhead",
@@ -90,8 +96,46 @@ def _check_global_soak(doc: dict) -> list[str]:
     return errors
 
 
+def _check_device_soak(doc: dict) -> list[str]:
+    """The device-recovery soak's acceptance bar beyond key presence
+    (doc/device_recovery.md): zero-loss census, bounded recovery,
+    ledger==metrics, no death declaration — and the engine actually
+    rebuilt in-process (a run where no rebuild happened proves
+    nothing)."""
+    errors: list[str] = []
+    names = {
+        c.get("name") for c in doc.get("invariants", {}).get("checks", [])
+    }
+    for required in (
+        "every_entity_in_exactly_one_cell",
+        "recovery_within_deadline",
+        "device_recoveries_ledger_matches_metric",
+        "gateway_never_declared_dead",
+        "device_state_active_at_end",
+    ):
+        if required not in names:
+            errors.append(f"missing invariant check {required!r}")
+    census = doc.get("census", {})
+    if census.get("missing") or census.get("duplicated"):
+        errors.append(f"entity census not clean: {census}")
+    counts = doc.get("device", {}).get("recovery_counts", {})
+    if not (counts.get("hang") or counts.get("corruption")
+            or counts.get("step_error")):
+        errors.append("no in-process engine rebuild recorded "
+                      f"(recovery_counts={counts})")
+    worst = doc.get("recoveries", {}).get("worst_s")
+    deadline = doc.get("recoveries", {}).get("deadline_s")
+    if worst is None or deadline is None or worst > deadline:
+        errors.append(
+            f"recovery bound not proven (worst={worst}, "
+            f"deadline={deadline})"
+        )
+    return errors
+
+
 EXTRA_CHECKS = {
     "SOAK_GLOBAL_*.json": _check_global_soak,
+    "SOAK_DEVICE_*.json": _check_device_soak,
 }
 
 

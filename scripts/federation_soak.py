@@ -255,6 +255,11 @@ async def boot_gateway(gw_id: str, fed_cfg: dict, params: FedSoakParams,
     # nondeterministic authority moves (L3 is driven explicitly in the
     # refusal phase instead).
     global_settings.balancer_enabled = False
+    # Device guard pinned OFF (doc/device_recovery.md): this soak's
+    # envelope is deterministic; the watchdog worker-thread hop and
+    # any chaos-adjacent retry would perturb it. The device plane's
+    # own soak is scripts/device_soak.py.
+    global_settings.device_guard_enabled = False
     # Global control plane pinned OFF (doc/global_control.md): its
     # leader-planned shard migrations and death declarations would add
     # nondeterministic authority moves to this soak's envelope

@@ -201,6 +201,11 @@ async def run_overload_soak(p: OverloadSoakParams) -> dict:
     # at L2+ anyway, but pinning it off keeps the saturation timeline
     # free of planned authority moves (scripts/balance_soak.py owns that).
     global_settings.balancer_enabled = False
+    # Device guard pinned OFF (doc/device_recovery.md): this soak's
+    # envelope is deterministic; the watchdog worker-thread hop and
+    # any chaos-adjacent retry would perturb it. The device plane's
+    # own soak is scripts/device_soak.py.
+    global_settings.device_guard_enabled = False
     # Flight recorder pinned OFF (doc/observability.md): these soaks
     # prove deterministic accounting and timing envelopes; span
     # recording and anomaly auto-dumps must not perturb either

@@ -198,6 +198,11 @@ async def run_live_phase(p: TraceSoakParams, dump_dir: str) -> dict:
 
     global_settings.development = True
     global_settings.balancer_enabled = False
+    # Device guard pinned OFF (doc/device_recovery.md): this soak's
+    # envelope is deterministic; the watchdog worker-thread hop and
+    # any chaos-adjacent retry would perturb it. The device plane's
+    # own soak is scripts/device_soak.py.
+    global_settings.device_guard_enabled = False
     global_settings.federation_config = ""
     # The ladder stays pinned at L0: boot-time jit compiles blow ticks,
     # and on a loaded box the resulting climb reaches L3 before the

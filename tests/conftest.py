@@ -57,7 +57,7 @@ def _fresh_globals(tmp_path):
     the test's tmp dir and starts each test with empty rings — anomaly
     auto-dumps from one test must not land in the repo's profiles/ or
     slow a later timing-sensitive test with a full-ring freeze."""
-    from channeld_tpu.core import events, overload, settings, tracing
+    from channeld_tpu.core import device_guard, events, overload, settings, tracing
     from channeld_tpu.spatial import balancer as balancer_mod
 
     tracing.recorder.configure(dump_path=str(tmp_path))
@@ -66,4 +66,5 @@ def _fresh_globals(tmp_path):
     settings.reset_global_settings()
     overload.reset_overload()
     balancer_mod.reset_balancer()
+    device_guard.reset_device_guard()
     tracing.reset_tracing()

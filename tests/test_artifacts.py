@@ -81,6 +81,61 @@ def test_global_soak_dirty_census_is_flagged(tmp_path):
     assert any("missing invariant check" in e for e in errors)
 
 
+def _device_soak_doc():
+    return {
+        "kind": "device_soak",
+        "invariants": {"ok": True, "checks": [
+            {"name": n, "ok": True} for n in (
+                "every_entity_in_exactly_one_cell",
+                "recovery_within_deadline",
+                "device_recoveries_ledger_matches_metric",
+                "gateway_never_declared_dead",
+                "device_state_active_at_end",
+            )
+        ]},
+        "device": {"state": "ACTIVE",
+                   "recovery_counts": {"hang": 1, "corruption": 1}},
+        "recoveries": {"worst_s": 0.4, "deadline_s": 10.0},
+        "census": {"missing": [], "duplicated": [], "total": 96},
+        "scenario": {}, "stats": {},
+    }
+
+
+def test_device_soak_schema_gate(tmp_path):
+    """SOAK_DEVICE_*.json extra checks: a clean artifact passes; a dirty
+    census, a blown recovery deadline, a run with no rebuild, and a
+    missing invariant name are each flagged."""
+    import json
+
+    path = tmp_path / "SOAK_DEVICE_r99.json"
+    path.write_text(json.dumps(_device_soak_doc()))
+    assert check_artifacts.check_artifacts(str(tmp_path)) == []
+
+    doc = _device_soak_doc()
+    doc["census"]["duplicated"] = [7]
+    path.write_text(json.dumps(doc))
+    assert any("census not clean" in e
+               for e in check_artifacts.check_artifacts(str(tmp_path)))
+
+    doc = _device_soak_doc()
+    doc["recoveries"]["worst_s"] = 99.0
+    path.write_text(json.dumps(doc))
+    assert any("recovery bound not proven" in e
+               for e in check_artifacts.check_artifacts(str(tmp_path)))
+
+    doc = _device_soak_doc()
+    doc["device"]["recovery_counts"] = {"transient": 2}
+    path.write_text(json.dumps(doc))
+    assert any("no in-process engine rebuild" in e
+               for e in check_artifacts.check_artifacts(str(tmp_path)))
+
+    doc = _device_soak_doc()
+    doc["invariants"]["checks"] = doc["invariants"]["checks"][1:]
+    path.write_text(json.dumps(doc))
+    assert any("missing invariant check" in e
+               for e in check_artifacts.check_artifacts(str(tmp_path)))
+
+
 def test_artifact_metric_refs_are_checked():
     """Committed artifacts citing metrics must cite registered families
     with the declared label sets (scripts/check_artifacts.py

@@ -1,0 +1,611 @@
+"""Device supervision & in-process engine recovery (core/device_guard.py):
+watchdog hang detection, transient-vs-fatal classification, the readback
+corruption sentinel, host-shadow rebuild determinism (bit-identical
+arrays, mid-crossing entities re-baselined from the failover journal),
+the overload-ladder pin while the engine is down, fatal/recovery
+snapshots, the graceful SIGTERM drain, and the <60s device smoke soak.
+
+The full acceptance soak (SOAK_DEVICE_r13.json) runs the same machinery
+via ``python scripts/device_soak.py`` and as the ``slow``-marked test at
+the bottom.
+"""
+
+import asyncio
+import importlib.util
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from channeld_tpu.chaos import arm, disarm
+from channeld_tpu.core.channel import get_channel
+from channeld_tpu.core.device_guard import (
+    DeviceState,
+    DeviceStepError,
+    classify_failure,
+    guard,
+)
+from channeld_tpu.core.failover import journal
+from channeld_tpu.core.message import MessageContext
+from channeld_tpu.core.overload import governor
+from channeld_tpu.core.settings import global_settings
+from channeld_tpu.core.subscription import subscribe_to_channel
+from channeld_tpu.core.types import ConnectionType, MessageType
+from channeld_tpu.models import sim_pb2
+from channeld_tpu.models.sim import register_sim_types
+from channeld_tpu.protocol import control_pb2
+from channeld_tpu.spatial.controller import (
+    SpatialInfo,
+    set_spatial_controller,
+)
+from channeld_tpu.spatial.tpu_controller import TPUSpatialController
+
+from helpers import StubConnection, fresh_runtime
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+START = 0x10000
+ENTITY_START = 0x80000
+
+
+@pytest.fixture(autouse=True)
+def runtime():
+    gch = fresh_runtime()
+    register_sim_types()
+    global_settings.development = True
+    global_settings.device_retry_backoff_ms = 1
+    yield gch
+    disarm()
+
+
+def entity_data(eid, x, z):
+    d = sim_pb2.SimEntityChannelData()
+    d.state.entityId = eid
+    d.state.transform.position.x = x
+    d.state.transform.position.z = z
+    return d
+
+
+def make_tpu_world():
+    """2x1 TPU world with two spatial servers and one entity in cell 0."""
+    global_settings.tpu_entity_capacity = 64
+    global_settings.tpu_query_capacity = 8
+    ctl = TPUSpatialController()
+    ctl.load_config(
+        dict(WorldOffsetX=0, WorldOffsetZ=0, GridWidth=100, GridHeight=100,
+             GridCols=2, GridRows=1, ServerCols=2, ServerRows=1,
+             ServerInterestBorderSize=1)
+    )
+    set_spatial_controller(ctl)
+    servers = []
+    for i in (1, 2):
+        server = StubConnection(i, ConnectionType.SERVER)
+        ctx = MessageContext(
+            msg_type=MessageType.CREATE_CHANNEL,
+            msg=control_pb2.CreateChannelMessage(),
+            connection=server,
+        )
+        for ch in ctl.create_channels(ctx):
+            subscribe_to_channel(server, ch, None)
+        servers.append(server)
+    return ctl, servers
+
+
+def add_entity(ctl, server, eid, x, z):
+    from channeld_tpu.core.channel import create_entity_channel
+
+    entity_ch = create_entity_channel(eid, server)
+    entity_ch.init_data(entity_data(eid, x, z), None)
+    entity_ch.spatial_notifier = ctl
+    cell_ch = get_channel(ctl.get_channel_id(SpatialInfo(x, 0, z)))
+    cell_ch.get_data_message().add_entity(eid, entity_ch.get_data_message())
+    ctl.track_entity(eid, SpatialInfo(x, 0, z))
+    return entity_ch
+
+
+# ---- classification --------------------------------------------------------
+
+
+def test_classify_failure():
+    assert classify_failure(
+        DeviceStepError("boom", transient=True)) == "transient"
+    assert classify_failure(DeviceStepError("boom")) == "fatal"
+    assert classify_failure(
+        RuntimeError("RESOURCE_EXHAUSTED: out of memory")) == "transient"
+    assert classify_failure(RuntimeError("UNAVAILABLE: busy")) == "transient"
+    assert classify_failure(
+        RuntimeError("INTERNAL: compilation failure")) == "fatal"
+    assert classify_failure(ValueError("anything else")) == "fatal"
+
+
+# ---- transient retry -------------------------------------------------------
+
+
+def test_transient_error_retries_without_rebuild():
+    """One transient step error degrades (held tick, ladder pinned L2);
+    the backoff retry succeeds and counts a 'transient' recovery — no
+    rebuild, no entity disturbance."""
+    ctl, (sa, sb) = make_tpu_world()
+    add_entity(ctl, sa, ENTITY_START + 1, 50, 50)
+    ctl.tick()
+    assert guard.state == DeviceState.ACTIVE
+    arm({"seed": 1, "faults": [
+        {"point": "device.step_error", "every_n": 1, "max_fires": 1}]})
+    ctl.tick()
+    assert guard.state == DeviceState.DEGRADED
+    assert governor.level == 2  # pinned: shedding outranks a dead engine
+    assert guard.failure_counts == {"step_error": 1}
+    time.sleep(0.005)
+    ctl.tick()
+    assert guard.state == DeviceState.ACTIVE
+    assert guard.recovery_counts == {"transient": 1}
+    assert governor._level_floor == 0  # released; decays via hysteresis
+
+
+def test_retries_exhausted_escalates_to_rebuild():
+    """Sustained step errors burn the retry budget, then the engine is
+    rebuilt in-process (cause=step_error) and serves again."""
+    ctl, (sa, sb) = make_tpu_world()
+    add_entity(ctl, sa, ENTITY_START + 1, 50, 50)
+    ctl.tick()
+    arm({"seed": 2, "faults": [
+        {"point": "device.step_error", "every_n": 1, "max_fires": 10}]})
+    for _ in range(10):
+        if guard.recovery_counts:
+            break
+        time.sleep(0.005)  # let each retry backoff lapse
+        ctl.tick()
+    disarm()
+    assert guard.recovery_counts == {"step_error": 1}
+    assert guard.failure_counts["step_error"] == 1 + global_settings.device_retry_max
+    assert guard.state == DeviceState.ACTIVE
+    ctl.tick()  # serves again
+
+
+# ---- watchdog / hang -------------------------------------------------------
+
+
+def test_hang_watchdog_abandons_and_rebuilds():
+    """A step stalled past the deadline is abandoned off-thread: the
+    zombie worker can never commit its tail state (generation fence),
+    the engine rebuilds, and the next tick serves from a fresh worker."""
+    ctl, (sa, sb) = make_tpu_world()
+    add_entity(ctl, sa, ENTITY_START + 1, 50, 50)
+    ctl.tick()
+    global_settings.device_step_deadline_s = 0.08
+    arm({"seed": 3, "faults": [
+        {"point": "device.step_hang", "every_n": 1, "max_fires": 1,
+         "stall_ms": 400}]})
+    t0 = time.monotonic()
+    ctl.tick()
+    assert time.monotonic() - t0 < 0.3  # the tick did NOT wait the stall out
+    assert guard.recovery_counts == {"hang": 1}
+    assert guard.state == DeviceState.ACTIVE
+    disarm()
+    time.sleep(0.5)  # the zombie wakes, sees the stale generation, raises
+    ctl.tick()
+    assert guard.state == DeviceState.ACTIVE
+
+
+# ---- corruption sentinel ---------------------------------------------------
+
+
+def test_nan_corruption_caught_by_sentinel_and_healed():
+    """device.nan rots the device state (NaN positions + garbage cell
+    baselines); the sentinel catches the impossible src cell from the
+    ordinary fetched handover rows — no extra transfers — and the
+    rebuild restores every entity bit-identically."""
+    ctl, (sa, sb) = make_tpu_world()
+    eids = [ENTITY_START + 1 + i for i in range(8)]
+    for i, eid in enumerate(eids):
+        add_entity(ctl, sa, eid, 10 + i * 5, 50)
+    ctl.tick()
+    arm({"seed": 4, "faults": [
+        {"point": "device.nan", "every_n": 1, "max_fires": 1}]})
+    ctl.tick()
+    disarm()
+    assert guard.recovery_counts == {"corruption": 1}
+    assert guard.failure_counts["corruption"] == 1
+    # Every entity still tracked on device with its true position.
+    for i, eid in enumerate(eids):
+        slot = ctl.engine.slot_of_entity(eid)
+        assert slot is not None
+        assert np.array_equal(
+            np.asarray(ctl.engine._d_positions[slot]),
+            np.array([10 + i * 5, 0, 50], np.float32),
+        )
+    # And the healed engine still detects crossings correctly.
+    ech = get_channel(eids[0])
+    ech.data.on_update(entity_data(eids[0], 150, 50), 0, sa.id, ctl)
+    ctl.tick()
+    get_channel(START).tick_once(0)
+    get_channel(START + 1).tick_once(0)
+    assert eids[0] in get_channel(START + 1).get_data_message().entities
+    assert eids[0] not in get_channel(START).get_data_message().entities
+
+
+def test_sentinel_checks():
+    """Unit coverage of the range checks themselves."""
+    ctl, _ = make_tpu_world()
+    eng = ctl.engine
+    result = {
+        "handover_count": 1,
+        "handovers": np.array([[0, 0, 1]], np.int32),
+        "due_packed": np.zeros((eng.sub_capacity + 7) // 8, np.uint8),
+    }
+    assert guard._sentinel(eng, result) is None
+    bad = dict(result, handover_count=-3)
+    assert "count" in guard._sentinel(eng, bad)
+    bad = dict(result, handovers=np.array([[0, 1 << 24, 1]], np.int32))
+    assert "impossible cell" in guard._sentinel(eng, bad)
+    bad = dict(result, handovers=np.array([[-1, 1 << 24, 1]], np.int32))
+    assert guard._sentinel(eng, bad) is None  # discard-lane row: ignored
+    bad = dict(result, due_packed=np.zeros(3, np.uint8))
+    assert "bitmap" in guard._sentinel(eng, bad)
+
+
+# ---- rebuild determinism ---------------------------------------------------
+
+
+def test_rebuild_bit_identical_including_mid_crossing_journal():
+    """The rebuild seeds every slot from where the entity's data
+    authoritatively lives: the failover journal's in-flight dst
+    outranks the committed placement ledger, which outranks the raw
+    position. Post-rebuild device arrays are bit-identical to the host
+    shadow (entities, queries, subs)."""
+    ctl, (sa, sb) = make_tpu_world()
+    e_plain = ENTITY_START + 1  # settled in cell 0
+    e_flight = ENTITY_START + 2  # mid-crossing 0 -> 1 in the journal
+    add_entity(ctl, sa, e_plain, 30, 50)
+    add_entity(ctl, sa, e_flight, 40, 50)
+    ctl.tick()
+    # Open an in-flight journal record: data bound for cell 1 even
+    # though _data_cell still says cell 0 (flips only on commit).
+    recs = journal.prepare({e_flight: entity_data(e_flight, 140, 50)},
+                           START, START + 1)
+    assert journal.pending_dst(e_flight) == START + 1
+    # Query + device-registered sub so the rebuild covers all tables.
+    conn = StubConnection(9, ConnectionType.CLIENT)
+    from channeld_tpu.ops.spatial_ops import AOI_SPHERE
+
+    ctl.engine.set_query(conn.id, AOI_SPHERE, (50.0, 50.0), (80.0, 80.0))
+    slot = ctl.device_sub_add(100, 0, START)
+    assert slot is not None
+
+    seeds = ctl.rebuild_seed_cells()
+    assert seeds[ctl.engine.slot_of_entity(e_plain)] == 0
+    assert seeds[ctl.engine.slot_of_entity(e_flight)] == 1  # journal wins
+
+    ctl.engine.rebuild_device_state(seeds)
+    assert ctl.engine.verify_device_state(seeds) == []
+    cells = np.asarray(ctl.engine._d_cell)
+    assert cells[ctl.engine.slot_of_entity(e_flight)] == 1
+    assert cells[ctl.engine.slot_of_entity(e_plain)] == 0
+    journal.commit(recs)
+
+
+def test_rebuild_verifies_with_nan_position_in_shadow():
+    """NaN coordinates are tolerated input (they assign outside the
+    world); a NaN in the host shadow must round-trip rebuild
+    verification instead of failing it forever — one bad client
+    position must never turn a recoverable fault into a permanent
+    outage."""
+    ctl, (sa, sb) = make_tpu_world()
+    eid = ENTITY_START + 1
+    add_entity(ctl, sa, eid, 50, 50)
+    ctl.engine.update_entity(eid, float("nan"), 0.0, 50.0)
+    ctl.tick()
+    arm({"seed": 9, "faults": [
+        {"point": "device.nan", "every_n": 1, "max_fires": 1}]})
+    ctl.tick()
+    disarm()
+    assert guard.recovery_counts == {"corruption": 1}
+    assert guard.state == DeviceState.ACTIVE
+
+
+def test_hung_rebuild_does_not_block_forever():
+    """The rebuild's device calls run through the same deadline-guarded
+    worker as the step: a rebuild wedged past 4x the deadline lands in
+    FAILED (backoff retry) instead of freezing the event loop."""
+    import channeld_tpu.core.device_guard as dg
+
+    ctl, (sa, sb) = make_tpu_world()
+    add_entity(ctl, sa, ENTITY_START + 1, 50, 50)
+    ctl.tick()
+    global_settings.device_step_deadline_s = 0.05
+    orig = dg.DeviceGuard._rebuild_body  # plain function via class access
+
+    def _wedged(engine, seeds, gen):
+        time.sleep(0.6)  # past 4x deadline: the device is still hung
+        return orig(engine, seeds, gen)
+
+    dg.DeviceGuard._rebuild_body = staticmethod(_wedged)
+    try:
+        arm({"seed": 10, "faults": [
+            {"point": "device.nan", "every_n": 1, "max_fires": 1}]})
+        t0 = time.monotonic()
+        ctl.tick()
+        # Each tick's rebuild wait is bounded by the step deadline —
+        # the loop is never parked for the wedge's full duration.
+        assert time.monotonic() - t0 < 0.4
+        assert guard.state == DeviceState.REBUILDING
+        give_up = time.monotonic() + 2.0
+        while guard.state != DeviceState.FAILED \
+                and time.monotonic() < give_up:
+            t1 = time.monotonic()
+            ctl.tick()  # polls; abandons once 4x deadline elapses
+            assert time.monotonic() - t1 < 0.4
+            time.sleep(0.02)
+        assert guard.state == DeviceState.FAILED
+        assert guard.failure_counts["rebuild_fail"] == 1
+    finally:
+        disarm()
+        dg.DeviceGuard._rebuild_body = staticmethod(orig)
+    time.sleep(0.7)  # zombie drains; stale-generation fence discards it
+    for _ in range(10):
+        if guard.state == DeviceState.ACTIVE:
+            break
+        time.sleep(0.05)
+        ctl.tick()
+    assert guard.state == DeviceState.ACTIVE
+    assert guard.recovery_counts == {"corruption": 1}
+
+
+def test_rebuild_seed_falls_back_to_position():
+    """An entity with neither a journal record nor a placement-ledger
+    row (first sighting that never orchestrated) seeds from its last
+    known position."""
+    ctl, (sa, sb) = make_tpu_world()
+    eid = ENTITY_START + 3
+    ctl.engine.add_entity(eid, 150, 0, 50)  # device-only registration
+    ctl._last_positions[eid] = SpatialInfo(150, 0, 50)
+    seeds = ctl.rebuild_seed_cells()
+    assert seeds[ctl.engine.slot_of_entity(eid)] == 1
+
+
+def test_rebuild_failure_retries_on_backoff():
+    """device.rebuild_fail fails the first rebuild attempt: the guard
+    lands in FAILED, holds, and the next eligible tick rebuilds
+    successfully."""
+    ctl, (sa, sb) = make_tpu_world()
+    add_entity(ctl, sa, ENTITY_START + 1, 50, 50)
+    ctl.tick()
+    arm({"seed": 5, "faults": [
+        {"point": "device.nan", "every_n": 1, "max_fires": 1},
+        {"point": "device.rebuild_fail", "every_n": 1, "max_fires": 1}]})
+    ctl.tick()
+    assert guard.state == DeviceState.FAILED
+    assert guard.failure_counts["rebuild_fail"] == 1
+    assert governor.level == 2  # still pinned while down
+    for _ in range(10):
+        if guard.state == DeviceState.ACTIVE:
+            break
+        time.sleep(0.01)
+        ctl.tick()
+    disarm()
+    assert guard.state == DeviceState.ACTIVE
+    assert guard.recovery_counts == {"corruption": 1}
+
+
+def test_crossing_during_outage_redetected_after_rebuild():
+    """An entity that moves across a boundary WHILE the engine is down
+    re-detects its crossing from the reseeded baseline — zero loss,
+    zero duplication, the acceptance invariant in miniature. Deferred
+    crossings dropped at the fatal are re-detected the same way."""
+    ctl, (sa, sb) = make_tpu_world()
+    eid = ENTITY_START + 1
+    ech = add_entity(ctl, sa, eid, 50, 50)
+    ctl.tick()
+    # Fatal + failed rebuild: the engine stays down.
+    arm({"seed": 6, "faults": [
+        {"point": "device.nan", "every_n": 1, "max_fires": 1},
+        {"point": "device.rebuild_fail", "every_n": 1, "max_fires": 1}]})
+    ctl.tick()
+    assert guard.state == DeviceState.FAILED
+    # The world moves while the engine is down (host mirrors absorb it).
+    ech.data.on_update(entity_data(eid, 150, 50), 0, sa.id, ctl)
+    ctl.tick()  # held (backoff) or rebuild; either way no crossing yet
+    for _ in range(10):
+        if guard.state == DeviceState.ACTIVE:
+            break
+        time.sleep(0.01)
+        ctl.tick()
+    disarm()
+    assert guard.state == DeviceState.ACTIVE
+    ctl.tick()  # the rebuilt engine re-detects 0 -> 1
+    get_channel(START).tick_once(0)
+    get_channel(START + 1).tick_once(0)
+    assert eid in get_channel(START + 1).get_data_message().entities
+    assert eid not in get_channel(START).get_data_message().entities
+
+
+# ---- degradation while down ------------------------------------------------
+
+
+def test_outage_pins_overload_ladder_until_recovery():
+    ctl, (sa, sb) = make_tpu_world()
+    add_entity(ctl, sa, ENTITY_START + 1, 50, 50)
+    ctl.tick()
+    assert governor.level == 0
+    arm({"seed": 7, "faults": [
+        {"point": "device.nan", "every_n": 1, "max_fires": 1},
+        {"point": "device.rebuild_fail", "every_n": 1, "max_fires": 3}]})
+    ctl.tick()
+    assert guard.state == DeviceState.FAILED
+    assert governor.level == 2 and governor._level_floor == 2
+    # The ladder cannot step below the floor while the engine is down.
+    governor._step_ladder(global_settings)
+    assert governor.level == 2
+    for _ in range(20):
+        if guard.state == DeviceState.ACTIVE:
+            break
+        time.sleep(0.01)
+        ctl.tick()
+    disarm()
+    assert guard.state == DeviceState.ACTIVE
+    assert governor._level_floor == 0
+
+
+def test_snapshots_on_fatal_and_recovery(tmp_path):
+    """A fatal failure snapshots immediately (pre-rebuild) and a
+    completed rebuild snapshots again, both through the shared fsync'd
+    write path — a crash during recovery boot-restores to the newest
+    state."""
+    ctl, (sa, sb) = make_tpu_world()
+    add_entity(ctl, sa, ENTITY_START + 1, 50, 50)
+    ctl.tick()
+    snap = tmp_path / "gateway.snap"
+    global_settings.snapshot_path = str(snap)
+    arm({"seed": 8, "faults": [
+        {"point": "device.nan", "every_n": 1, "max_fires": 1}]})
+    ctl.tick()
+    disarm()
+    assert guard.recovery_counts == {"corruption": 1}
+    assert snap.exists()
+    from channeld_tpu.protocol import snapshot_pb2
+
+    parsed = snapshot_pb2.GatewaySnapshot()
+    parsed.ParseFromString(snap.read_bytes())
+    assert len(parsed.channels) > 0
+
+
+# ---- graceful shutdown -----------------------------------------------------
+
+
+def test_drain_gateway_parks_clients_and_snapshots(tmp_path):
+    """SIGTERM drain: every client gets a ServerBusyMessage{retryAfterMs}
+    then its socket closes, and the final snapshot lands through the
+    fsync'd write path."""
+    from channeld_tpu.core import connection as connection_mod
+    from channeld_tpu.core.connection import add_connection
+    from channeld_tpu.core.server import drain_gateway
+    from channeld_tpu.protocol.framing import FrameDecoder
+
+    from helpers import FakeTransport
+
+    connection_mod.set_fsm_templates(None, None)
+    global_settings.snapshot_path = str(tmp_path / "drain.snap")
+    transport = FakeTransport()
+    conn = add_connection(transport, ConnectionType.CLIENT)
+    report = asyncio.run(drain_gateway())
+    assert report["clients_parked"] == 1
+    assert conn.is_closing()
+    packs = [
+        mp
+        for data in transport.written
+        for p in FrameDecoder().decode_packets(bytes(data))
+        for mp in p.messages
+    ]
+    busy = [mp for mp in packs if mp.msgType == MessageType.SERVER_BUSY]
+    assert len(busy) == 1
+    msg = control_pb2.ServerBusyMessage()
+    msg.ParseFromString(busy[0].msgBody)
+    assert msg.reason == "shutdown"
+    assert msg.retryAfterMs == global_settings.overload_retry_after_ms
+    assert os.path.exists(report["snapshot"])
+
+
+def test_goodbye_fast_tracks_death_declaration():
+    """A goodbye heartbeat skips the death-miss window: the leader
+    declares at the next death check instead of waiting out
+    global_death_miss_epochs of ambiguous silence."""
+    from test_global_control import arm as arm_control
+
+    from channeld_tpu.federation.control import control
+
+    fake = arm_control("b", peers=("a", "c"))
+    global_settings.global_epoch_ms = 500
+    global_settings.global_death_miss_epochs = 4  # 2s window
+    control.on_peer_goodbye("a")
+    del fake.links["a"]
+    control.on_trunk_down("a")
+    control._check_deaths()  # immediately, not 2s later
+    assert "a" in control.dead
+    assert control.deaths == 1
+    # A returning peer supersedes its goodbye.
+    control.dead.discard("a")
+    fake.links["a"] = type(fake.links["c"])()
+    control.on_trunk_up("a")
+    assert "a" not in control._goodbyes
+
+
+def test_goodbye_rides_the_heartbeat_wire():
+    """announce_goodbye emits goodbye heartbeats on live trunks and the
+    receiving link forwards them to the plane then drops the link."""
+    from channeld_tpu.core.types import MessageType as MT
+    from channeld_tpu.federation.trunk import TrunkLink
+
+    seen = []
+    downs = []
+
+    class _W:
+        class transport:
+            @staticmethod
+            def abort():
+                pass
+
+        @staticmethod
+        def write(data):
+            pass
+
+        @staticmethod
+        def close():
+            pass
+
+    link = TrunkLink(
+        "a", None, _W(),
+        on_message=lambda p, t, m: seen.append((p, t, m)),
+        on_down=lambda p, l: downs.append(p),
+    )
+    hb = control_pb2.TrunkHeartbeatMessage(sentAtMs=1, goodbye=True)
+    link._on_heartbeat(hb)
+    assert seen and seen[0][0] == "a" and seen[0][1] == int(MT.TRUNK_HEARTBEAT)
+    assert seen[0][2].goodbye
+    assert downs == ["a"] and not link.alive
+
+
+# ---- the device smoke soak (tier-1) ----------------------------------------
+
+
+def _load_device_soak():
+    spec = importlib.util.spec_from_file_location(
+        "device_soak", os.path.join(REPO, "scripts", "device_soak.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["device_soak"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_device_smoke_soak():
+    """Seeded <60s live soak: a real gateway with live clients and a
+    handover burst survives device.step_error / device.step_hang /
+    device.nan (plus one rebuild_fail) with zero entities lost or
+    duplicated, recovery inside the deadline, exact double-entry
+    recovery accounting, and no death declarations. The full acceptance
+    soak is the slow-marked variant below."""
+    mod = _load_device_soak()
+    # Phases spaced so a loaded CI box's scheduling jitter (retry
+    # backoffs, a slow real step) can never overlap two failure
+    # windows — the transient sequence must finish before the hang.
+    p = mod.SoakParams(
+        duration_s=26.0, clients=6, entities=48, msg_rate=15.0,
+        quiesce_s=6.0, scenario=mod.build_scenario(
+            seed=20260804, error_at=4.0, hang_at=11.0, nan_at=17.0),
+    )
+    report = asyncio.run(mod.run_soak(p))
+    failed = [c for c in report["invariants"]["checks"] if not c["ok"]]
+    assert report["invariants"]["ok"], failed
+    assert report["device"]["recovery_counts"]
+    assert report["device"]["state"] == "ACTIVE"
+
+
+@pytest.mark.slow
+def test_device_full_soak():
+    """The acceptance soak (SOAK_DEVICE_r13.json is its artifact)."""
+    mod = _load_device_soak()
+    p = mod.SoakParams(duration_s=60.0)
+    report = asyncio.run(mod.run_soak(p))
+    failed = [c for c in report["invariants"]["checks"] if not c["ok"]]
+    assert report["invariants"]["ok"], failed
