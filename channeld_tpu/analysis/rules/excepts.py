@@ -36,7 +36,14 @@ SCOPE: tuple[tuple[str, str], ...] = (
     ("channeld_tpu/federation/control.py",
      r"^(_epoch_tick|_on_|_process_death|_begin_|_advance_|_finalize_|"
      r"_kick_drain|_census_advance|_restore_unclaimed|_evacuate_|"
-     r"_replicate|_check_)"),
+     r"_replicate|_check_|_announce_resurrection|_yield_shard)"),
+    # WAL hook surface (doc/persistence.md): these run on the tick path
+    # — a swallowed failure here silently un-journals a transition and
+    # the crash soak's exactness evaporates. The writer thread
+    # (_writer_loop/_rewrite) is out of scope by design: it owns its
+    # I/O error handling and never runs on the tick path.
+    ("channeld_tpu/core/wal.py",
+     r"^(append|note_dirty|on_global_tick|log_|_count_)"),
 )
 
 _LOG_OK = {"warning", "error", "exception", "critical"}

@@ -48,6 +48,11 @@ HOT_PATHS: tuple[tuple[str, str], ...] = (
      r"^(_epoch_tick|_on_|_process_death|_begin_|_advance_|_finalize_|"
      r"_kick_drain|_census_advance|_restore_unclaimed|_evacuate_|"
      r"_sweep_stale_rows|_replicate|_build_vector)"),
+    # WAL append surface (doc/persistence.md): journal hooks run inside
+    # ticks and must never force a device sync (or any I/O — fsync
+    # lives on the off-thread writer, which is out of scope by design).
+    ("channeld_tpu/core/wal.py",
+     r"^(append|note_dirty|on_global_tick|log_)"),
 )
 
 # Calls that force a device->host transfer for ONE row/scalar.

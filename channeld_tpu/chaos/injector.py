@@ -52,6 +52,11 @@ POINTS = {
     # federation trunk plane (federation/trunk.py)
     "trunk.egress_drop": "drop an outbound trunk frame (lossy inter-gateway link)",
     "trunk.sever": "abort the trunk socket before the write (link partition)",
+    # durable persistence plane (core/wal.py)
+    "wal.torn_write": "write only a prefix of a WAL record (power loss "
+                      "mid-append; replay must truncate at the bad CRC)",
+    "wal.fsync_stall": "stall the off-thread writer before fsync (slow "
+                       "disk; the tick path must stay unaffected)",
 }
 
 

@@ -34,6 +34,7 @@ from .data import (
 from .overload import governor as _governor
 from .settings import global_settings
 from .tracing import recorder as _trace
+from .wal import wal as _wal
 from .types import BroadcastType, ChannelType, ConnectionType, GLOBAL_CHANNEL_ID, MessageType
 
 logger = get_logger("channel")
@@ -207,6 +208,10 @@ class Channel:
         if factory is not None:
             self.data.extension = factory()
             self.data.extension.init(self)
+        if _wal.enabled:
+            # Direct init_data callers (entity spawn paths, federation
+            # adoption) bypass the message queue: mark here too.
+            _wal.note_dirty(self.id)
 
     def get_data_message(self):
         return self.data.msg if self.data else None
@@ -469,6 +474,14 @@ class Channel:
         self._tick_messages(tick_start)
         if had_msgs:
             _trace.stage("messages", msg_start, lane=self.id)
+            # WAL dirty mark (doc/persistence.md): every channel-data
+            # mutation runs through this queue (update merges AND
+            # execute closures), so a post-drain mark captures exactly
+            # the channels whose state may have changed this tick. One
+            # set-add; the GLOBAL tick coalesces the set into
+            # channel_state records.
+            if _wal.enabled and self.data is not None:
+                _wal.note_dirty(self.id)
         fanout_start = time.monotonic()
         tick_data(self, now)
         if self.subscribed_connections:
@@ -497,6 +510,12 @@ class Channel:
             gov_start = time.monotonic_ns()
             _governor.update(self.tick_interval)
             _trace.stage("overload", gov_start, lane=self.id)
+            if _wal.enabled:
+                # Drain the dirty set into journal records — inside the
+                # GLOBAL tick, the same single-writer context the epoch
+                # replica packs cell state in. Enqueue-only: the fsync
+                # lives on the WAL's writer thread.
+                _wal.on_global_tick()
         if _trace.enabled:
             # The tick span closes HERE (after the governor update) so
             # the overload stage nests inside it — containment is how
@@ -839,6 +858,8 @@ def remove_channel(ch: Channel) -> None:
     _signal_drain()
     _all_channels.pop(ch.id, None)
     metrics.channel_num.labels(channel_type=ch.channel_type.name).dec()
+    if _wal.enabled:
+        _wal.log_channel_removed(ch.id)
     events.channel_removed.broadcast(ch.id)
 
 

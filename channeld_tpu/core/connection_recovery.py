@@ -248,6 +248,12 @@ def stage_recovery_handle(
             release_connection_id(old.prev_conn_id)
         _recover_handles[pit] = handle
 
+    from .wal import wal as _wal
+
+    if _wal.enabled:
+        # Staged handles are durable (doc/persistence.md): a redirected
+        # client must still resume here after a crash-restart.
+        _wal.log_staged_handle(pit, channel_ids)
     opts = control_pb2.ChannelSubscriptionOptions()
     if sub_options is not None:
         opts.MergeFrom(sub_options)
@@ -263,6 +269,29 @@ def stage_recovery_handle(
             old_sub_options=opts,
         )
     return handle
+
+
+def staged_handle_snapshot() -> list[tuple[str, list[int]]]:
+    """(pit, channel ids) for every outstanding STAGED handle — the
+    gateway snapshot's extras (doc/persistence.md): a staged redirect
+    must survive a crash-restart or the redirected client re-auths
+    against a gateway that promised it recovery. Live-session handles
+    (a real disconnect mid-window) ride too: their channel set is
+    whatever channels hold their recoverable subs."""
+    from .channel import all_channels
+
+    channels_of: dict[str, list[int]] = {}
+    for cid, ch in all_channels().items():
+        if ch.is_removing():
+            continue
+        for pit, rsub in ch.recoverable_subs.items():
+            channels_of.setdefault(pit, []).append(cid)
+    out: list[tuple[str, list[int]]] = []
+    for pit, handle in _recover_handles.items():
+        if handle.new_conn is not None:
+            continue  # mid-recovery; the live connection owns it now
+        out.append((pit, sorted(channels_of.get(pit, []))))
+    return sorted(out)
 
 
 def recover_from_handle(conn: "Connection", handle: ConnectionRecoverHandle) -> None:

@@ -29,6 +29,53 @@ def is_pit_banned(pit: str) -> bool:
     return pit in _pit_blacklist
 
 
+def ban_ip(ip: str) -> None:
+    """The ONE write path for an IP ban: the blacklist entry plus its
+    WAL record (doc/persistence.md) — a crash-restart must not hand
+    attackers a clean slate."""
+    from .wal import wal
+
+    if ip not in _ip_blacklist and wal.enabled:
+        wal.log_blacklist("ip", ip)
+    _ip_blacklist[ip] = time.monotonic()
+
+
+def ban_pit(pit: str) -> None:
+    """The ONE write path for a PIT ban (see :func:`ban_ip`)."""
+    from .wal import wal
+
+    if pit not in _pit_blacklist and wal.enabled:
+        wal.log_blacklist("pit", pit)
+    _pit_blacklist[pit] = time.monotonic()
+
+
+def blacklist_snapshot() -> tuple[list[str], list[str]]:
+    """(banned ips, banned pits) for the gateway snapshot's extras."""
+    return sorted(_ip_blacklist), sorted(_pit_blacklist)
+
+
+def restore_blacklists(ips, pits) -> tuple[int, int]:
+    """Boot-restore path (snapshot + WAL replay): re-arm persisted bans.
+    Restored entries get a fresh monotonic stamp — ban age does not
+    survive a restart, which errs on the side of keeping attackers out."""
+    now = time.monotonic()
+    n_ips = n_pits = 0
+    for ip in ips:
+        if ip not in _ip_blacklist:
+            _ip_blacklist[ip] = now
+            n_ips += 1
+    for pit in pits:
+        if pit not in _pit_blacklist:
+            _pit_blacklist[pit] = now
+            n_pits += 1
+    if n_ips or n_pits:
+        security_logger().info(
+            "restored %d IP and %d PIT blacklist entries from durable "
+            "state", n_ips, n_pits,
+        )
+    return n_ips, n_pits
+
+
 def track_unauthenticated(conn) -> None:
     if global_settings.connection_auth_timeout_ms > 0:
         _unauthenticated_connections[conn.id] = conn
@@ -49,7 +96,7 @@ def on_auth_result(conn, result, pit: str = "") -> None:
         _failed_auth_counters[key] = _failed_auth_counters.get(key, 0) + 1
         limit = global_settings.max_failed_auth_attempts
         if limit > 0 and _failed_auth_counters[key] >= limit:
-            _pit_blacklist[key] = time.monotonic()
+            ban_pit(key)
             security_logger().info("blacklisted PIT %s: too many failed auths", key)
             conn.close()
     elif result == AuthResult.INVALID_PIT:
@@ -59,7 +106,7 @@ def on_auth_result(conn, result, pit: str = "") -> None:
         _failed_auth_counters[ip] = _failed_auth_counters.get(ip, 0) + 1
         limit = global_settings.max_failed_auth_attempts
         if limit > 0 and _failed_auth_counters[ip] >= limit:
-            _ip_blacklist[ip] = time.monotonic()
+            ban_ip(ip)
             security_logger().info("blacklisted IP %s: too many failed auths", ip)
             conn.close()
 
@@ -78,7 +125,7 @@ def init_anti_ddos() -> None:
         conn.fsm_disallowed_counter += 1
         limit = global_settings.max_fsm_disallowed
         if limit > 0 and conn.fsm_disallowed_counter >= limit:
-            _pit_blacklist[conn.pit] = time.monotonic()
+            ban_pit(conn.pit)
             security_logger().info(
                 "blacklisted PIT %s: too many FSM-disallowed messages", conn.pit
             )
@@ -104,7 +151,7 @@ def check_unauth_conns_once() -> None:
         ):
             ip = conn.remote_ip()
             if ip is not None:
-                _ip_blacklist[ip] = now
+                ban_ip(ip)
             conn.close()
             security_logger().info(
                 "closed and blacklisted unauthenticated connection from %s", ip

@@ -191,6 +191,16 @@ class HandoverJournal:
 
         metrics.handover_journal.labels(state=state).inc(n)
 
+    def _wal_log(self, op: str, rec: "HandoverRecord") -> None:
+        """Journal transitions ride the WAL (doc/persistence.md): a
+        crash mid-handover replays to exactly one owning cell — the
+        restored src on a lost commit, with a source-wins abort notice
+        at a remote batch's destination."""
+        from .wal import wal
+
+        if wal.enabled:
+            wal.log_journal(op, rec)
+
     # ---- the transaction surface (called from grid orchestration) -------
 
     def prepare(
@@ -206,6 +216,7 @@ class HandoverJournal:
             )
             self._in_flight[entity_id] = rec
             records.append(rec)
+            self._wal_log(PREPARED, rec)
         self._count(PREPARED, len(records))
         return records
 
@@ -229,6 +240,7 @@ class HandoverJournal:
             if rec.state in (PREPARED, REMOVED):
                 rec.state = COMMITTED
                 committed += 1
+                self._wal_log(COMMITTED, rec)
                 # Flip only on a REAL commit: an ABORTED record (entity
                 # destroyed mid-flight) must not resurrect a ledger row
                 # its cleanup already removed.
@@ -245,6 +257,7 @@ class HandoverJournal:
         if rec.state not in (COMMITTED, ABORTED):
             rec.state = ABORTED
             self._count(ABORTED)
+            self._wal_log(ABORTED, rec)
         if self._in_flight.get(rec.entity_id) is rec:
             del self._in_flight[rec.entity_id]
 
@@ -306,6 +319,7 @@ class HandoverJournal:
         if rec is not None and rec.state not in (COMMITTED, ABORTED):
             rec.state = ABORTED
             self._count(ABORTED)
+            self._wal_log(ABORTED, rec)
 
     # ---- failover resolution --------------------------------------------
 

@@ -332,6 +332,72 @@ device_rebuild_ms = Histogram(
     registry=registry,
 )
 
+# Durable persistence plane (core/wal.py; doc/persistence.md).
+wal_records = Counter(
+    "wal_records",
+    "Write-ahead journal records appended, by kind (channel_state: "
+    "coalesced per-tick channel images; channel_removed: tombstones; "
+    "journal: handover prepare/commit/abort transitions; batch / "
+    "batch_done / applied: remote-batch lifecycle; flip: placement-"
+    "ledger moves; staged_handle / directory / blacklist: the non-"
+    "channel durable state). The python ledger in core/wal.py "
+    "(record_counts) must match exactly",
+    ["kind"],
+    registry=registry,
+)
+wal_replayed = Counter(
+    "wal_replayed",
+    "Write-ahead journal records applied by boot replay, by kind (the "
+    "restart-side half of the wal_records double entry; torn-tail "
+    "records truncated at the first bad CRC are never counted). The "
+    "python ledger in core/wal.py (replay_counts) must match exactly",
+    ["kind"],
+    registry=registry,
+)
+wal_fsync_ms = Histogram(
+    "wal_fsync_ms",
+    "Duration of one WAL fsync batch on the off-thread writer "
+    "(append() itself never blocks the tick path; this is the "
+    "durability interval — RPO is one of these batches), milliseconds",
+    buckets=(0.5, 1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0, 500.0),
+    registry=registry,
+)
+resurrection = Counter(
+    "resurrection",
+    "Fleet resurrection-protocol outcomes (announced: a crash-restarted "
+    "gateway sent its trunk hello; yielded: it learned its shard was "
+    "adopted while down and handed the adopter its missing WAL-"
+    "recovered entities; reclaimed: death was never declared and it "
+    "kept its shard; unresolved: no peer answered by the restart "
+    "deadline, ordinary zombie evacuation took over; peer_yielded / "
+    "peer_reclaimed: the receiving "
+    "side's count of each reply it sent). The python ledger in "
+    "federation/control.py (resurrections) must match exactly",
+    ["outcome"],
+    registry=registry,
+)
+snapshot_writes = Counter(
+    "snapshot_writes",
+    "Periodic-snapshot loop outcomes (written: state changed and an "
+    "fsync-then-rename write landed; skipped: the packed state hashed "
+    "identical to the previous write — no disk traffic; failed: the "
+    "write raised and will retry next interval)",
+    ["result"],
+    registry=registry,
+)
+snapshot_bytes = Gauge(
+    "snapshot_bytes",
+    "Serialized size of the last written gateway snapshot",
+    registry=registry,
+)
+snapshot_ms = Histogram(
+    "snapshot_ms",
+    "Duration of one periodic snapshot cycle (pack + hash, plus the "
+    "off-thread fsync'd write when the state changed), milliseconds",
+    buckets=(0.5, 1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0, 500.0),
+    registry=registry,
+)
+
 # Overload-control plane (core/overload.py; doc/overload.md).
 overload_level = Gauge(
     "overload_level",

@@ -503,17 +503,28 @@ async def drain_gateway(listeners: Optional[list] = None) -> dict:
     # drain still leaves a consistent file.
     if global_settings.snapshot_path:
         from .snapshot import take_snapshot, write_snapshot
+        from .wal import wal
 
         try:
             snap = take_snapshot()
             await asyncio.to_thread(
                 write_snapshot, snap, global_settings.snapshot_path
             )
+            wal.checkpoint(snap.walSeq)
             report["snapshot"] = global_settings.snapshot_path
             logger.info("final snapshot of %d channels written to %s",
                         len(snap.channels), global_settings.snapshot_path)
         except Exception:
             logger.exception("final shutdown snapshot failed")
+    if global_settings.wal_path:
+        # Final durability barrier off the loop: everything appended so
+        # far fsyncs before the process exits (a parallel snapshot
+        # failure above must not lose the journal tail either).
+        from .wal import wal
+
+        if wal.enabled:
+            await asyncio.to_thread(wal.flush)
+            wal.stop()
     logger.warning(
         "drain complete: %d clients parked, %d trunk peers said goodbye",
         report["clients_parked"], report["goodbye_peers"],
@@ -669,6 +680,25 @@ async def run_server(argv: Optional[list[str]] = None) -> None:
             logger.warning("metrics port %d unavailable; /metrics disabled",
                            global_settings.metrics_port)
 
+    # Durable-state boot BEFORE the trunks/listeners come up: restore
+    # the snapshot and replay the WAL tail (doc/persistence.md) so the
+    # resurrection announce is armed by the time the first trunk
+    # handshakes, then start the journal writer continuing the sequence
+    # above everything replay observed.
+    if global_settings.wal_path:
+        from .wal import boot_replay, wal
+
+        replay_report = boot_replay(
+            global_settings.snapshot_path, global_settings.wal_path
+        )
+        wal.start(global_settings.wal_path,
+                  initial_seq=replay_report.get("max_seq", 0))
+    elif global_settings.snapshot_path:
+        from .snapshot import boot_restore
+
+        # Restore-at-boot (corrupt/missing files never block boot).
+        boot_restore(global_settings.snapshot_path)
+
     tasks = [
         asyncio.ensure_future(flush_loop()),
         asyncio.ensure_future(unauth_reaper_loop()),
@@ -683,11 +713,10 @@ async def run_server(argv: Optional[list[str]] = None) -> None:
         tasks.append(asyncio.ensure_future(connection_recovery_loop()))
 
     if global_settings.snapshot_path:
-        from .snapshot import boot_restore, snapshot_loop
+        from .snapshot import snapshot_loop
 
-        # Restore-at-boot (corrupt/missing files never block boot), then
-        # the periodic fsync-then-rename writer on -snapshot-interval.
-        boot_restore(global_settings.snapshot_path)
+        # The periodic skip-unchanged fsync-then-rename writer on
+        # -snapshot-interval (each write checkpoints the WAL).
         tasks.append(asyncio.ensure_future(snapshot_loop(
             global_settings.snapshot_path, global_settings.snapshot_interval_s
         )))

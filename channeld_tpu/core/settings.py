@@ -117,6 +117,25 @@ class GlobalSettings:
     snapshot_path: str = ""
     snapshot_interval_s: float = 30.0
 
+    # Durable write-ahead journal (new — doc/persistence.md). Empty
+    # path = the WAL plane stays disarmed and every hook is one
+    # attribute load. With a path, every authoritative state transition
+    # (coalesced per-tick channel images, handover-journal transitions,
+    # placement flips, staged handles, directory versions, blacklists)
+    # is appended CRC-framed and fsync-batched on an off-thread writer,
+    # so a kill -9 loses at most one fsync batch instead of one
+    # snapshot interval; the periodic snapshot checkpoints (truncates)
+    # the journal, and boot replays snapshot + WAL tail (a torn final
+    # record is truncated at the first bad CRC).
+    wal_path: str = ""
+    # The writer's fsync batch window: smaller = tighter RPO, more
+    # fsyncs. The tick path only ever enqueues; fsync never runs on it.
+    wal_fsync_ms: float = 20.0
+    # Operator bound on restart-to-serving (boot restore + WAL replay +
+    # controller re-seed); overruns warn and fail the crash soak — a
+    # slow replay still beats lost state.
+    wal_restart_deadline_s: float = 30.0
+
     # Prometheus /metrics port (the reference hardcodes :8080,
     # metrics.go; a flag lets N gateways share one host).
     metrics_port: int = 8080
@@ -386,6 +405,15 @@ class GlobalSettings:
                             "restored at boot when present")
         p.add_argument("-mport", type=int, default=self.metrics_port,
                        help="Prometheus /metrics port (0 disables)")
+        p.add_argument("-wal", type=str, default="",
+                       help="path for the durable write-ahead journal "
+                            "(doc/persistence.md); replayed over the "
+                            "snapshot at boot, truncated by each "
+                            "snapshot write; empty disables")
+        p.add_argument("-wal-fsync-ms", type=float,
+                       default=self.wal_fsync_ms,
+                       help="WAL fsync batch window (off-thread writer; "
+                            "the RPO of a kill -9)")
         p.add_argument("-snapshot-interval", type=float,
                        default=self.snapshot_interval_s)
         p.add_argument("-spatial-backend", type=str, default=self.spatial_backend,
@@ -574,6 +602,8 @@ class GlobalSettings:
         self.tpu_mesh_hosts = args.mesh_hosts
         self.snapshot_path = args.snapshot
         self.snapshot_interval_s = args.snapshot_interval
+        self.wal_path = args.wal
+        self.wal_fsync_ms = args.wal_fsync_ms
         self.metrics_port = args.mport
         self.import_modules = [m for m in args.imports.split(",") if m]
         self.load_channel_settings(args.chs)

@@ -91,6 +91,8 @@ class MessageType(IntEnum):
     TRUNK_ADOPT_DONE = 43
     TRUNK_ADOPT_QUERY = 44
     TRUNK_ADOPT_CLAIMS = 45
+    # Durable persistence plane (core/wal.py, 46; doc/persistence.md).
+    TRUNK_RESURRECT_HELLO = 46
     DEBUG_GET_SPATIAL_REGIONS = 99
     USER_SPACE_START = 100
 

@@ -197,8 +197,15 @@ class GlobalControlPlane:
         self._goodbyes: set[str] = set()
         self._crossings_acc = 0
         self._crossing_rate = 0.0
+        # Resurrection handshake state (doc/persistence.md): armed by
+        # the WAL boot replay on a crash-restarted gateway; None on a
+        # fresh boot. Holds the peers announced to, their acks, and the
+        # terminal resolution (yielded / reclaimed / unresolved).
+        self._resurrect: Optional[dict] = None
         # Python-side ledgers; must match global_migrations_total{result}
-        # and gateway_adoptions_total exactly.
+        # and gateway_adoptions_total exactly — and resurrections must
+        # match resurrection_total{outcome}.
+        self.resurrections: dict[str, int] = {}
         self.ledger: dict[str, int] = {}
         self.adoptions = 0
         self.deaths = 0
@@ -215,6 +222,13 @@ class GlobalControlPlane:
 
     def _note(self, key: str, n: int = 1) -> None:
         self.counters[key] = self.counters.get(key, 0) + n
+
+    def _count_resurrection(self, outcome: str, n: int = 1) -> None:
+        self.resurrections[outcome] = \
+            self.resurrections.get(outcome, 0) + n
+        from ..core import metrics
+
+        metrics.resurrection.labels(outcome=outcome).inc(n)
 
     def _event(self, e: dict) -> None:
         append_event(self.events, e)
@@ -291,6 +305,10 @@ class GlobalControlPlane:
         self._down_since.pop(peer, None)
         # A returning peer supersedes any earlier goodbye (it restarted).
         self._goodbyes.discard(peer)
+        if self._resurrect is not None and not self._resurrect["resolved"]:
+            # Crash-restarted gateway: introduce ourselves on every
+            # trunk as it comes up (doc/persistence.md).
+            self._announce_resurrection(peer)
         if peer in self.dead:
             # A declared-dead gateway reconnected (it was partitioned,
             # not crashed). Its shard has been adopted; sync it the
@@ -1171,6 +1189,24 @@ class GlobalControlPlane:
     def _advance_purges(self) -> None:
         from ..core.channel import get_channel
 
+        r = self._resurrect
+        if r is not None and not r["resolved"]:
+            # A pending resurrection handshake owns the zombie-cell
+            # resolution: the adopter's ack decides which residents
+            # hand over and which drop (its copy wins). Evacuating now
+            # would ship conflicting copies source-wins — the WRONG
+            # direction for a returned corpse. Bounded: past the
+            # restart deadline the ordinary evacuation (which never
+            # deletes a possibly-only copy) takes over.
+            if time.monotonic() < r["deadline"]:
+                return
+            r["resolved"] = True
+            self._count_resurrection("unresolved")
+            logger.warning(
+                "resurrection handshake unresolved past the %.0fs "
+                "restart deadline; falling back to zombie evacuation",
+                global_settings.wal_restart_deadline_s,
+            )
         for cid, e0 in list(self._purge_candidates.items()):
             if self._drain is not None and self._drain.cell_id == cid:
                 # A planned drain owns this cell's teardown.
@@ -1290,6 +1326,260 @@ class GlobalControlPlane:
             "epoch": self.epoch,
         })
         return True
+
+    # ---- resurrection (doc/persistence.md) -------------------------------
+
+    def arm_resurrection(self, wal_replayed: int,
+                         restored_entities=()) -> None:
+        """Called by the WAL boot replay on a gateway that restarted
+        from durable state: announce on every trunk (now and as later
+        links come up) with the last persisted directory version and
+        the replayed shard census. The handshake resolves to exactly
+        one of: *yielded* (the shard was adopted while down — hand the
+        adopter the WAL-recovered entities it is missing, drop the
+        rest; its copy wins on conflict), *reclaimed* (death was never
+        declared — keep serving), or *unresolved* (no peer answered by
+        the deadline — fall back to the ordinary zombie-evacuation
+        machinery, which never deletes a possibly-only copy)."""
+        self._resurrect = {
+            "replayed": wal_replayed,
+            "announced": set(), "acks": {},
+            "resolved": False, "yielded_to": set(),
+            # In-flight entities the replay restored via QUEUED re-adds
+            # (the src cell's next tick lands them): the census must
+            # name them even when the hello beats that tick, or a
+            # reclaim peer's fsync-window reconciliation would restore
+            # a second copy from its retention.
+            "restored": set(restored_entities),
+            "deadline": time.monotonic()
+            + global_settings.wal_restart_deadline_s,
+        }
+        self._count_resurrection("announced")
+        self._event({"kind": "resurrect_armed", "replayed": wal_replayed})
+        if self.active:
+            for peer in self.live_peers():
+                self._announce_resurrection(peer)
+
+    def _resurrect_census(self) -> tuple[list[int], list[int]]:
+        """(hosted cells, resident entity ids) as the replay restored
+        them — NOT filtered by the directory: the whole point is that
+        the fleet map may have moved on while this gateway was down."""
+        from ..core.channel import all_channels
+
+        st = global_settings
+        lo, hi = st.spatial_channel_id_start, st.entity_channel_id_start
+        cells: list[int] = []
+        ents: set[int] = set()
+        for cid, ch in all_channels().items():
+            if lo <= cid < hi and not ch.is_removing():
+                cells.append(cid)
+                rows = getattr(ch.get_data_message(), "entities", None)
+                if rows:
+                    ents.update(rows)
+        r = self._resurrect
+        if r is not None:
+            # Queued in-flight restores whose re-add hasn't ticked yet
+            # still belong to the census (their entity channels exist).
+            from ..core.channel import get_channel
+
+            ents.update(e for e in r["restored"]
+                        if get_channel(e) is not None)
+        return sorted(cells), sorted(ents)
+
+    def _announce_resurrection(self, peer: str) -> None:
+        r = self._resurrect
+        link = self.plane.link_to(peer) if self.plane is not None else None
+        if r is None or peer in r["announced"] or link is None:
+            return
+        cells, ents = self._resurrect_census()
+        sent = link.send(
+            MessageType.TRUNK_RESURRECT_HELLO,
+            control_pb2.TrunkResurrectHelloMessage(
+                gatewayId=directory.local_id,
+                directoryVersion=directory.override_version,
+                cellIds=cells, entityIds=ents,
+                walReplayed=r["replayed"],
+            ),
+        )
+        if sent:
+            r["announced"].add(peer)
+            logger.warning(
+                "resurrection hello -> %s: %d cells, %d entities, "
+                "directory v%d (%d WAL records replayed)",
+                peer, len(cells), len(ents),
+                directory.override_version, r["replayed"],
+            )
+
+    def _on_resurrect_hello(self, peer: str, msg) -> None:
+        if msg.ack:
+            self._on_resurrect_ack(peer, msg)
+            return
+        local = directory.local_id
+        # Its shard was adopted iff the fleet map no longer points its
+        # census cells at it (the death re-map's overrides) — or we
+        # still carry it in the dead set (the trunk-up discard can race
+        # a hello coalesced into the same read).
+        shard_adopted = peer in self.dead or any(
+            directory.gateway_of_cell(c) not in (None, peer)
+            for c in msg.cellIds
+        )
+        reply = control_pb2.TrunkResurrectHelloMessage(
+            gatewayId=local, ack=True, shardAdopted=shard_adopted,
+            directoryVersion=directory.override_version,
+        )
+        if shard_adopted and any(
+            directory.gateway_of_cell(c) == local for c in msg.cellIds
+        ):
+            # WE adopted (some of) its cells: name the census entities
+            # we do NOT host — the returnee hands exactly those over
+            # and drops the rest (our copy wins on conflict).
+            reply.isAdopter = True
+            reply.missingEntityIds.extend(
+                e for e in msg.entityIds if not self._hosts_entity(e)
+            )
+        self._count_resurrection(
+            "peer_yielded" if shard_adopted else "peer_reclaimed"
+        )
+        # Census reconciliation for the ack-vs-fsync window: a batch we
+        # committed INTO the returnee may have been applied and acked
+        # there inside its final (never-fsync'd) WAL batch — our copy
+        # was torn down on the ack, its copy died with the crash, and on
+        # a RECLAIM nothing else would ever restore it (the retained-
+        # batch machinery only fires on a death declaration). The hello
+        # census names every entity the replay recovered; any retained-
+        # batch entity absent from it — and not live anywhere we can
+        # see — is restored here from the retained data.
+        restored_lost: list[int] = []
+        retained = self._retained.get(peer)
+        if retained and not shard_adopted:
+            census = set(msg.entityIds)
+            for batch in list(retained.values()):
+                for rec in batch.records:
+                    if rec.entity_id in census \
+                            or self._hosts_entity(rec.entity_id):
+                        continue
+                    if self._restore_entity(rec.entity_id, rec.data,
+                                            batch.src_channel_id):
+                        restored_lost.append(rec.entity_id)
+        if restored_lost:
+            self._note("resurrect_fsync_window_restored",
+                       len(restored_lost))
+            logger.warning(
+                "resurrection census of %s is missing %d entities we "
+                "committed into it (lost to its final fsync window): "
+                "restored from commit retention", peer,
+                len(restored_lost),
+            )
+        self._event({
+            "kind": "resurrect_hello", "peer": peer,
+            "cells": len(msg.cellIds), "entities": len(msg.entityIds),
+            "adopted": shard_adopted,
+            "missing": list(reply.missingEntityIds),
+            "fsync_window_restored": restored_lost,
+            "epoch": self.epoch,
+        })
+        logger.warning(
+            "resurrection hello from %s (%d cells, %d entities): shard "
+            "%s%s", peer, len(msg.cellIds), len(msg.entityIds),
+            "ADOPTED while it was down" if shard_adopted else "intact "
+            "(death never declared) — it reclaims",
+            f"; {len(reply.missingEntityIds)} entities missing here"
+            if reply.isAdopter else "",
+        )
+        link = self.plane.link_to(peer) if self.plane is not None else None
+        if link is not None:
+            link.send(MessageType.TRUNK_RESURRECT_HELLO, reply)
+
+    def _on_resurrect_ack(self, peer: str, msg) -> None:
+        r = self._resurrect
+        if r is None:
+            return
+        r["acks"][peer] = msg
+        if msg.shardAdopted:
+            if not r["resolved"]:
+                r["resolved"] = True
+                self._count_resurrection("yielded")
+                self._event({
+                    "kind": "resurrect_yielded", "adopter_known": peer,
+                    "epoch": self.epoch,
+                })
+            if msg.isAdopter and peer not in r["yielded_to"]:
+                # Every adopter yields independently: post-death
+                # migrations can split the shard across several
+                # gateways, and each ack names only the cells its
+                # sender now owns (_yield_shard filters by the
+                # directory) — yielding to just the first would leave
+                # the second adopter's cells to fall back to
+                # source-wins evacuation, the wrong conflict direction.
+                r["yielded_to"].add(peer)
+                self._yield_shard(peer, set(msg.missingEntityIds))
+        else:
+            if not r["resolved"] and r["announced"] \
+                    and set(r["acks"]) >= r["announced"]:
+                # Every announced peer answered "not adopted": the
+                # death was never declared — this gateway keeps its
+                # shard and serves on; peers resync through the
+                # ordinary epoch machinery.
+                r["resolved"] = True
+                self._count_resurrection("reclaimed")
+                self._event({"kind": "resurrect_reclaimed",
+                             "epoch": self.epoch})
+                logger.warning(
+                    "resurrection resolved: shard RECLAIMED (death was "
+                    "never declared; %d peers confirmed)",
+                    len(r["acks"]),
+                )
+
+    def _yield_shard(self, adopter: str, missing: set[int]) -> None:
+        """The returnee's half of a yielded resurrection: for every
+        entity in a cell now mapped to the adopter — hand it over the
+        trunk when the adopter is missing it (exactly-once via the
+        ordinary trunked transactional handover + applied registry),
+        drop the local copy when the adopter already holds one (its
+        copy wins: it served the entity while we were dead). Emptied
+        zombie cells then purge through the normal candidate
+        machinery."""
+        from ..core.channel import all_channels, get_channel, \
+            remove_channel
+
+        st = global_settings
+        lo, hi = st.spatial_channel_id_start, st.entity_channel_id_start
+        by_cell: dict[int, list[int]] = {}
+        dropped: list[int] = []
+        for cid, ch in list(all_channels().items()):
+            if not (lo <= cid < hi) or ch.is_removing():
+                continue
+            if directory.gateway_of_cell(cid) != adopter:
+                continue
+            rows = getattr(ch.get_data_message(), "entities", None) or ()
+            for eid in sorted(rows):
+                if eid in missing:
+                    by_cell.setdefault(cid, []).append(eid)
+                else:
+                    self.plane._purge_local_placement(eid)
+                    ech = get_channel(eid)
+                    if ech is not None and not ech.is_removing():
+                        remove_channel(ech)
+                    dropped.append(eid)
+        handed = 0
+        for cid, eids in sorted(by_cell.items()):
+            handed += len(eids)
+            self.plane.initiate_handover(
+                cid, cid, [lambda s, d, e=eid: e for eid in eids]
+            )
+        self._note("resurrect_entities_handed", handed)
+        self._note("resurrect_conflicts_dropped", len(dropped))
+        self._event({
+            "kind": "resurrect_yield_shard", "adopter": adopter,
+            "handed": handed, "dropped_ids": dropped,
+            "epoch": self.epoch,
+        })
+        logger.warning(
+            "yielding shard to %s: %d WAL-recovered entities handed "
+            "over (adopter was missing them), %d conflicting copies "
+            "dropped (adopter's copy wins)", adopter, handed,
+            len(dropped),
+        )
 
     # ---- death detection + declaration -----------------------------------
 
@@ -2067,6 +2357,7 @@ class GlobalControlPlane:
                 MessageType.TRUNK_ADOPT_DONE,
                 MessageType.TRUNK_ADOPT_QUERY,
                 MessageType.TRUNK_ADOPT_CLAIMS,
+                MessageType.TRUNK_RESURRECT_HELLO,
             )
         if msg_type == MessageType.TRUNK_LOAD_REPORT:
             self.vectors[msg.gatewayId or peer] = {
@@ -2095,6 +2386,8 @@ class GlobalControlPlane:
             self._on_adopt_claims(peer, msg)
         elif msg_type == MessageType.TRUNK_ADOPT_DONE:
             self._on_adopt_done(peer, msg)
+        elif msg_type == MessageType.TRUNK_RESURRECT_HELLO:
+            self._on_resurrect_hello(peer, msg)
         else:
             return False
         return True
@@ -2110,6 +2403,7 @@ class GlobalControlPlane:
             "imbalance": round(self.imbalance, 4),
             "vectors": {g: dict(v) for g, v in self.vectors.items()},
             "ledger": dict(self.ledger),
+            "resurrections": dict(self.resurrections),
             "adoptions": self.adoptions,
             "deaths": self.deaths,
             "counters": dict(self.counters),

@@ -138,11 +138,21 @@ class ShardDirectory:
             return False
         self._override_version = version
         self._overrides.update(overrides)
+        self._wal_log()
         logger.info(
             "directory updated to v%d: %d cell overrides active",
             version, len(self._overrides),
         )
         return True
+
+    def _wal_log(self) -> None:
+        """Directory versions are durable (doc/persistence.md): a
+        crash-restarted gateway must not boot believing a pre-override
+        shard map — its resurrection hello carries this version."""
+        from ..core.wal import wal
+
+        if wal.enabled:
+            wal.log_directory(self._override_version, self._overrides)
 
     def replace_update(self, overrides: dict[int, str],
                        version: int) -> Optional[dict[int, str]]:
@@ -161,6 +171,7 @@ class ShardDirectory:
         old = self._overrides
         self._override_version = version
         self._overrides = dict(overrides)
+        self._wal_log()
         changed: dict[int, str] = {}
         for cid in set(old) | set(overrides):
             if old.get(cid) != overrides.get(cid):

@@ -310,6 +310,13 @@ class FederationPlane:
 
         metrics.handover_count.inc(len(handover_entities))
         global_control.note_crossing(len(handover_entities))
+        from ..core.wal import wal as _wal
+
+        if _wal.enabled:
+            # Batch grouping in the WAL (doc/persistence.md): a crash
+            # before the ack replays the prepared records to src and
+            # sends a source-wins abort notice under THIS batch id.
+            _wal.log_batch(batch_id, peer, list(handover_entities))
         # Eager replica delta BEFORE the prepare: if this gateway dies
         # with the prepare undelivered, some survivor's replica still
         # carries the batch for the adoption's source-wins replay.
@@ -400,6 +407,10 @@ class FederationPlane:
                 not_before=time.monotonic() + retry_after,
             )
         self._count("aborted", len(batch.records))
+        from ..core.wal import wal as _wal
+
+        if _wal.enabled:
+            _wal.log_batch_done(batch.batch_id, batch.peer, "aborted")
         if busy is not None:
             self._count("refused")  # batches, == busy frames received
         global_control.note_batch_aborted(batch, busy is not None)
@@ -458,6 +469,10 @@ class FederationPlane:
                 self._stage_redirect(conn, eid, batch)
                 redirected.append(conn_id)
         self._count("committed", len(batch.records))
+        from ..core.wal import wal as _wal
+
+        if _wal.enabled:
+            _wal.log_batch_done(batch.batch_id, batch.peer, "committed")
         # Commit retention (doc/global_control.md): the peer now holds
         # the only live copy; keep the batch until the peer's shard
         # replica covers it — the resurrection material if it dies
@@ -709,6 +724,14 @@ class FederationPlane:
                                               list(adopted))
         while len(self._applied) > MAX_APPLIED_BATCHES:
             self._applied.popitem(last=False)
+        from ..core.wal import wal as _wal
+
+        if _wal.enabled:
+            # The applied registry must survive a crash-restart: the
+            # initiator's retransmitted abort notices key on it
+            # (source-wins reconciliation, doc/persistence.md).
+            _wal.log_applied(peer, msg.batchId, msg.dstChannelId,
+                             list(adopted))
         self._count("applied", len(adopted))
         self._event({
             "kind": "applied", "batch": msg.batchId, "peer": peer,
@@ -930,9 +953,10 @@ class FederationPlane:
             # the death-miss window for it.
             global_control.on_peer_goodbye(peer)
         elif MessageType.TRUNK_LOAD_REPORT <= msg_type \
-                <= MessageType.TRUNK_ADOPT_CLAIMS:
-            # Global-control traffic (38-45): channel mutations, so it
-            # dispatches inside the GLOBAL tick like handover traffic.
+                <= MessageType.TRUNK_RESURRECT_HELLO:
+            # Global-control + resurrection traffic (38-46): channel
+            # mutations, so it dispatches inside the GLOBAL tick like
+            # handover traffic.
             self._in_global_tick(
                 lambda: global_control.on_trunk_message(peer, msg_type,
                                                         msg)

@@ -61,6 +61,8 @@ TRUNK_MESSAGES = {
     MessageType.TRUNK_ADOPT_DONE: control_pb2.TrunkAdoptDoneMessage,
     MessageType.TRUNK_ADOPT_QUERY: control_pb2.TrunkAdoptQueryMessage,
     MessageType.TRUNK_ADOPT_CLAIMS: control_pb2.TrunkAdoptClaimsMessage,
+    # Durable persistence plane (core/wal.py; doc/persistence.md).
+    MessageType.TRUNK_RESURRECT_HELLO: control_pb2.TrunkResurrectHelloMessage,
 }
 
 

@@ -549,6 +549,14 @@ class StaticGrid2DSpatialController:
         global-control adoption bootstrap."""
         for eid in entity_ids:
             self._data_cell[eid] = dst_channel_id
+        from ..core.wal import wal as _wal
+
+        if _wal.enabled:
+            # Placement flips ride the WAL (doc/persistence.md): boot
+            # replay re-seeds the ledger from the restored cell rows,
+            # then overlays these so a mid-crossing entity re-baselines
+            # to where its data is BOUND, not where a stale row says.
+            _wal.log_flip(entity_ids, dst_channel_id)
 
     def on_cell_rehosted(self, cell_channel_id: int, new_owner) -> None:
         """Failover hook (core/failover.py): the cell's authority moved

@@ -65,6 +65,12 @@ SCHEMAS: dict[str, set] = {
     "TRACE_*.json": _SOAK_KEYS | {
         "stages", "anomaly_dumps", "cross_gateway", "overhead",
     },
+    # Crash-restart soak (doc/persistence.md acceptance artifact): the
+    # kill -9 timeline, the boot-replay report, the resurrection
+    # outcomes, and the WAL double-entry ledgers.
+    "SOAK_CRASH_*.json": _SOAK_KEYS | {
+        "crashes", "replay", "resurrection", "wal", "census",
+    },
 }
 
 
@@ -133,9 +139,50 @@ def _check_device_soak(doc: dict) -> list[str]:
     return errors
 
 
+def _check_crash_soak(doc: dict) -> list[str]:
+    """The crash soak's acceptance bar beyond key presence
+    (doc/persistence.md): >= 2 kill -9 crashes mid-handover-burst with
+    one shard adopted and one reclaimed, zero committed entities lost
+    or duplicated fleet-wide, restart-to-serving bounded, a torn WAL
+    tail replayed past truncation, and wal/resurrection ledger==metric
+    invariants present."""
+    errors: list[str] = []
+    names = {
+        c.get("name") for c in doc.get("invariants", {}).get("checks", [])
+    }
+    for required in (
+        "both_kills_mid_handover_burst",
+        "zero_committed_entities_lost_or_duplicated",
+        "restart_to_serving_within_deadline",
+        "replay_within_deadline",
+        "torn_tail_replayed",
+        "shard_reclaimed_after_restart",
+        "shard_yielded_after_restart",
+    ):
+        if required not in names:
+            errors.append(f"missing invariant check {required!r}")
+    if not any(n and n.endswith("_ledger_matches_metric") for n in names):
+        errors.append("no ledger==metrics invariant checks")
+    crashes = doc.get("crashes", [])
+    if len(crashes) < 2:
+        errors.append(f"fewer than 2 crashes recorded ({len(crashes)})")
+    phases = {c.get("phase") for c in crashes}
+    if not {"reclaim", "adopt"} <= phases:
+        errors.append(f"crash phases {sorted(phases)} missing "
+                      "reclaim/adopt coverage")
+    if not any(c.get("torn") for c in crashes):
+        errors.append("no crash replayed a torn WAL tail")
+    census = doc.get("census", {})
+    if census.get("missing") or census.get("duplicated") \
+            or census.get("unexpected"):
+        errors.append(f"crash census not clean: {census}")
+    return errors
+
+
 EXTRA_CHECKS = {
     "SOAK_GLOBAL_*.json": _check_global_soak,
     "SOAK_DEVICE_*.json": _check_device_soak,
+    "SOAK_CRASH_*.json": _check_crash_soak,
 }
 
 

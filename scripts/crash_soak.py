@@ -1,0 +1,821 @@
+"""Crash soak: kill -9 a live gateway, restart it, prove zero loss.
+
+The acceptance proof for the durable persistence plane
+(channeld_tpu/core/wal.py, doc/persistence.md). Two REAL gateway
+processes — this one in-process (gateway "a", the lowest id and
+therefore the leader) plus a ``--role remote`` child ("b", the crash
+victim) — share a 4x4 world split down the middle, both with the WAL
+armed (CRC-framed, fsync-batched journal + periodic checkpointing
+snapshots):
+
+1. **boot + traffic** — both gateways bring up their shards with
+   snapshot+WAL persistence, populations spawn on both sides, and
+   cross-gateway handovers commit in both directions (a's commit
+   retention and b's applied-batch registry both accumulate durable
+   state).
+2. **crash RECLAIMED** — the leader's death-miss window is pinned wide
+   open, a herd into "b" starts, and "b" is SIGKILLed while trunk
+   handover batches are in flight. In-flight batches abort back to "a"
+   (entities restored, abort notices queued). "b" restarts from its
+   snapshot + WAL tail, announces itself with a resurrection hello —
+   death was never declared, so it RECLAIMS its shard: the parked
+   crossings re-offer and commit, a's retransmitted abort notices purge
+   any pre-crash applied copies through the REPLAYED applied-batch
+   registry (source-wins), and the census stays exact.
+3. **crash ADOPTED** — chaos point ``wal.torn_write`` tears "b"'s next
+   journal append (simulated power loss mid-write), the death-miss
+   window drops to normal, and "b" is SIGKILLed mid-burst again. The
+   leader declares it dead and adopts the shard (restoring its own
+   retained committed-into-b batches as resurrection candidates). "b"
+   restarts — boot replay TRUNCATES the torn tail at the first bad CRC
+   and replays the committed prefix — announces, learns its shard was
+   adopted, and YIELDS: it hands "a" exactly the WAL-recovered entities
+   "a" is missing over the ordinary trunked transactional handover and
+   drops its copies of the rest (the adopter's copy wins on conflict).
+4. **census** — traffic stops, everything drains, both gateways report.
+
+The invariant checker asserts the PR's acceptance bar: >= 2 kill -9
+crashes mid-handover-burst (one reclaimed, one adopted), **zero
+committed entities lost or duplicated fleet-wide** after restart +
+reconciliation, restart-to-serving within the configured deadline, the
+torn WAL tail replayed past truncation, and the
+``wal_records_total{kind}`` / ``wal_replayed_total{kind}`` /
+``resurrection_total{outcome}`` python ledgers exactly equal to the
+prometheus metrics on every gateway.
+
+Run the acceptance soak (~2-4 min wall, dominated by child boots):
+  python scripts/crash_soak.py --out SOAK_CRASH_r14.json
+
+The <60s CI smoke runs the adopted-crash phase only with smaller
+numbers (tests/test_wal.py::test_crash_smoke_soak).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPTS = os.path.dirname(os.path.abspath(__file__))
+for p in (REPO, SCRIPTS):
+    if p not in sys.path:
+        sys.path.insert(0, p)
+
+import argparse
+import asyncio
+import json
+import shutil
+import signal
+import subprocess
+import time
+from dataclasses import dataclass, field
+from random import Random
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from federation_soak import (  # noqa: E402
+    Child,
+    FedSim,
+    FedSoakParams,
+    WORLD_SPATIAL,
+    _fed_config,
+    _free_ports,
+    boot_gateway,
+    local_placement,
+    teardown_gateway,
+)
+
+XR = {"a": (-98.0, -2.0), "b": (2.0, 98.0)}
+ZR = (-98.0, 98.0)
+BASE = {"a": 0, "b": 1000}
+
+
+@dataclass
+class CrashSoakParams:
+    seed: int = 20260804
+    base_entities: int = 12      # per gateway at boot
+    committed_each_way: int = 4  # pre-crash cross-gateway commits
+    kill_burst: int = 8          # a->b herd in flight at each SIGKILL
+    phases: tuple = ("reclaim", "adopt")
+    epoch_ms: int = 250          # gateway a (leader) control epoch
+    epoch_ms_b: int = 10_000     # b exports no replicas mid-soak
+    death_miss_epochs: int = 4
+    heartbeat_ms: int = 150
+    trunk_timeout_ms: int = 900
+    handover_timeout_ms: int = 1500
+    global_tick_ms: int = 20
+    fsync_ms: float = 10.0
+    snapshot_interval_s: float = 2.0
+    restart_deadline_s: float = 90.0   # SIGKILL -> serving (incl. boot)
+    phase_timeout_s: float = 30.0
+    quiesce_s: float = 2.0
+    child_boot_timeout_s: float = 90.0
+    out_path: str = ""
+    state_dir: str = ""
+
+
+# ---------------------------------------------------------------------------
+# shared WAL-armed boot
+# ---------------------------------------------------------------------------
+
+
+def persistence_paths(state_dir: str, gw_id: str) -> tuple[str, str]:
+    return (os.path.join(state_dir, f"gw_{gw_id}.snap"),
+            os.path.join(state_dir, f"gw_{gw_id}.wal"))
+
+
+def wal_settings_hook(gw_id: str, state_dir: str, p: CrashSoakParams):
+    snap_path, wal_path = persistence_paths(state_dir, gw_id)
+
+    def hook(gs) -> None:
+        gs.global_control_enabled = True
+        gs.global_epoch_ms = p.epoch_ms if gw_id == "a" else p.epoch_ms_b
+        gs.global_death_miss_epochs = p.death_miss_epochs
+        gs.global_min_entity_delta = 10_000  # no rebalancing noise
+        gs.failover_enabled = True
+        gs.snapshot_path = snap_path
+        gs.snapshot_interval_s = p.snapshot_interval_s
+        gs.wal_path = wal_path
+        gs.wal_fsync_ms = p.fsync_ms
+
+    return hook
+
+
+def wal_pre_start_hook(gw_id: str, state_dir: str, sink: dict):
+    """boot_gateway pre_start_hook: replay snapshot+WAL (no-op on a
+    virgin state dir) and start the journal writer — BEFORE
+    plane.start(), so the resurrection announce is armed by the time
+    the first trunk handshakes."""
+
+    def hook() -> None:
+        from channeld_tpu.core.wal import boot_replay, wal
+
+        snap_path, wal_path = persistence_paths(state_dir, gw_id)
+        t0 = time.monotonic()
+        sink["replay"] = boot_replay(snap_path, wal_path)
+        sink["replay"]["wall_s"] = round(time.monotonic() - t0, 3)
+        wal.start(wal_path,
+                  initial_seq=sink["replay"].get("max_seq", 0))
+
+    return hook
+
+
+def wal_metric_delta(baseline: dict) -> dict:
+    """wal_records_total{kind} / wal_replayed_total{kind} /
+    resurrection_total{outcome} deltas from the in-process registry —
+    the far side of the persistence plane's double-entry ledgers."""
+    from channeld_tpu.chaos.invariants import delta, scrape
+
+    out: dict = {"records": {}, "replayed": {}, "resurrection": {}}
+    for (name, labels), value in delta(scrape(), baseline).items():
+        if not value:
+            continue
+        if name == "wal_records_total":
+            out["records"][dict(labels)["kind"]] = int(value)
+        elif name == "wal_replayed_total":
+            out["replayed"][dict(labels)["kind"]] = int(value)
+        elif name == "resurrection_total":
+            out["resurrection"][dict(labels)["outcome"]] = int(value)
+    return out
+
+
+def persistence_report(baseline: dict, replay: dict) -> dict:
+    from channeld_tpu.core.wal import wal
+    from channeld_tpu.federation.control import control
+
+    return {
+        "wal": wal.report(),
+        "replay": replay,
+        "metric": wal_metric_delta(baseline),
+        "resurrections": dict(control.resurrections),
+    }
+
+
+# ---------------------------------------------------------------------------
+# remote role: gateway "b", the crash victim
+# ---------------------------------------------------------------------------
+
+
+async def remote_main(args) -> None:
+    from channeld_tpu.chaos import arm as chaos_arm
+    from channeld_tpu.chaos.invariants import scrape
+    from channeld_tpu.core.failover import journal
+    from channeld_tpu.core.snapshot import snapshot_loop
+    from channeld_tpu.core.wal import wal
+
+    baseline = scrape()  # before any WAL/replay counter moves
+    with open(args.config) as f:
+        fed_cfg = json.load(f)
+    p = CrashSoakParams(
+        epoch_ms=args.epoch_ms, epoch_ms_b=args.epoch_ms_b,
+        heartbeat_ms=args.heartbeat_ms,
+        trunk_timeout_ms=args.trunk_timeout_ms,
+        handover_timeout_ms=args.handover_timeout_ms,
+        death_miss_epochs=args.death_miss_epochs,
+        fsync_ms=args.fsync_ms,
+        snapshot_interval_s=args.snapshot_interval_s,
+    )
+    fp = FedSoakParams(
+        heartbeat_ms=p.heartbeat_ms, trunk_timeout_ms=p.trunk_timeout_ms,
+        handover_timeout_ms=p.handover_timeout_ms,
+        global_tick_ms=p.global_tick_ms,
+    )
+    stop = asyncio.Event()
+    sink: dict = {"replay": {}}
+    gw = await boot_gateway(
+        "b", fed_cfg, fp, stop,
+        settings_hook=wal_settings_hook("b", args.state_dir, p),
+        pre_start_hook=wal_pre_start_hook("b", args.state_dir, sink),
+    )
+    plane = gw["plane"]
+    ctl = gw["ctl"]
+    snap_path, _wal_path = persistence_paths(args.state_dir, "b")
+    snap_task = asyncio.ensure_future(
+        snapshot_loop(snap_path, p.snapshot_interval_s)
+    )
+    rng = Random(args.seed ^ 0xB)
+    sim = FedSim(ctl, rng)
+    print("READY", flush=True)
+
+    loop = asyncio.get_running_loop()
+    reader = asyncio.StreamReader()
+    await loop.connect_read_pipe(
+        lambda: asyncio.StreamReaderProtocol(reader), sys.stdin
+    )
+    while True:
+        line = await reader.readline()
+        if not line:
+            break
+        try:
+            cmd = json.loads(line)
+        except ValueError:
+            continue
+        name = cmd.get("cmd")
+        if name == "spawn":
+            sim.create_entities(
+                int(cmd["n"]), *XR["b"], *ZR,
+                base=BASE["b"] + int(cmd.get("offset", 0)),
+            )
+            print(f"OK spawn {cmd['n']}", flush=True)
+        elif name == "herd_to":
+            sim.adopt_scan()
+            tx0, tx1 = XR[cmd["gw"]]
+            ids = sim.local_ids()[: int(cmd.get("n", 4))]
+            moved = sim.herd(ids, tx0, tx1, ZR[0], ZR[1])
+            print(f"OK herd_to {len(moved)}", flush=True)
+        elif name == "flush_wal":
+            # Durability barrier: everything appended so far fsyncs
+            # (the soak's definition of "committed" for the census).
+            ok = await asyncio.to_thread(wal.flush, 10.0)
+            print(f"OK flush_wal {ok}", flush=True)
+        elif name == "arm_torn":
+            # The next WAL append tears mid-write and the writer wedges
+            # — simulated power loss; replay must truncate at the CRC.
+            # A marker record is appended immediately so the tear is on
+            # disk DETERMINISTICALLY before the kill (everything the
+            # burst appends after it is discarded, exactly as if the
+            # power died here).
+            chaos_arm({
+                "seed": args.seed,
+                "faults": [{"point": "wal.torn_write", "every_n": 1,
+                            "max_fires": 1}],
+            })
+            wal.log_flip([], 0)  # the record that tears
+            await asyncio.to_thread(wal.flush, 5.0)
+            print("OK arm_torn", flush=True)
+        elif name == "quiesce":
+            deadline = time.monotonic() + float(cmd.get("drain_s", 10.0))
+            while time.monotonic() < deadline and (
+                plane._pending or plane._parked
+                or journal.in_flight_count()
+            ):
+                await asyncio.sleep(0.1)
+            print("OK quiesce", flush=True)
+        elif name == "report":
+            report = {
+                "gateway": "b",
+                "ledger": dict(plane.ledger),
+                "persistence": persistence_report(baseline,
+                                                  sink["replay"]),
+                "placement": local_placement(),
+                "pending": len(plane._pending),
+                "parked": len(plane._parked),
+                "journal": journal.report(),
+                "events": plane.events[-300:],
+            }
+            with open(args.report, "w") as f:
+                json.dump(report, f)
+            print("OK report", flush=True)
+        elif name == "exit":
+            break
+    stop.set()
+    snap_task.cancel()
+    teardown_gateway(gw)
+
+
+# ---------------------------------------------------------------------------
+# the soak
+# ---------------------------------------------------------------------------
+
+
+def _spawn_child(cfg_path: str, report_path: str, state_dir: str,
+                 p: CrashSoakParams, generation: int) -> subprocess.Popen:
+    errlog = open(f"{report_path}.b{generation}.log", "w")
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--role", "remote",
+         "--config", cfg_path, "--report", report_path,
+         "--state-dir", state_dir,
+         "--seed", str(p.seed + generation),
+         "--epoch-ms", str(p.epoch_ms),
+         "--epoch-ms-b", str(p.epoch_ms_b),
+         "--heartbeat-ms", str(p.heartbeat_ms),
+         "--trunk-timeout-ms", str(p.trunk_timeout_ms),
+         "--handover-timeout-ms", str(p.handover_timeout_ms),
+         "--death-miss-epochs", str(p.death_miss_epochs),
+         "--fsync-ms", str(p.fsync_ms),
+         "--snapshot-interval-s", str(p.snapshot_interval_s)],
+        cwd=REPO, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=errlog, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+
+
+@dataclass
+class CrashEvent:
+    phase: str
+    mid_burst: bool = False
+    restart_s: float = 0.0
+    replay: dict = field(default_factory=dict)
+
+
+async def run_crash_soak(p: CrashSoakParams) -> dict:
+    from channeld_tpu.chaos.invariants import InvariantChecker, scrape
+    from channeld_tpu.core.failover import journal
+    from channeld_tpu.core.settings import global_settings
+    from channeld_tpu.core.snapshot import snapshot_loop
+    from channeld_tpu.core.wal import wal
+    from channeld_tpu.federation.control import control
+
+    t_start = time.monotonic()
+    baseline = scrape()
+    ports = dict(zip(("a_trunk", "a_client", "b_trunk", "b_client"),
+                     _free_ports(4)))
+    fed_cfg = _fed_config(ports)
+    pid = os.getpid()
+    state_dir = p.state_dir or f"/tmp/crash_soak_state_{pid}"
+    os.makedirs(state_dir, exist_ok=True)
+    cfg_path = f"/tmp/crash_soak_cfg_{pid}.json"
+    b_report_path = f"/tmp/crash_soak_b_{pid}.json"
+    with open(cfg_path, "w") as f:
+        json.dump(fed_cfg, f)
+
+    generation = 0
+    b_proc = _spawn_child(cfg_path, b_report_path, state_dir, p, generation)
+    b = Child(b_proc)
+
+    stop = asyncio.Event()
+    gw = None
+    snap_task = None
+    timeline: list[dict] = []
+    notes: list[str] = []
+    crashes: list[CrashEvent] = []
+
+    def mark(phase: str, **kw) -> None:
+        timeline.append({
+            "t": round(time.monotonic() - t_start, 2), "phase": phase, **kw
+        })
+
+    async def wait_trunk(plane, timeout: float) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline and plane.link_to("b") is None:
+            await asyncio.sleep(0.05)
+        if plane.link_to("b") is None:
+            raise RuntimeError("trunk to b never (re-)established")
+
+    async def kill_mid_burst(plane, sim, phase: str) -> CrashEvent:
+        """Herd a->b, SIGKILL b the moment a batch toward it is in
+        flight (the mid-handover-burst crash the acceptance bar
+        demands)."""
+        sim.adopt_scan()
+        ids = [e for e in sim.local_ids()][: p.kill_burst]
+        sim.herd(ids, *XR["b"], *ZR)
+        ev = CrashEvent(phase=phase)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if any(bt.peer == "b" for bt in plane._pending.values()):
+                b_proc.send_signal(signal.SIGKILL)
+                ev.mid_burst = True
+                break
+            await asyncio.sleep(0)
+        if not ev.mid_burst:
+            b_proc.send_signal(signal.SIGKILL)
+            notes.append(f"{phase}: kill raced, no batch in flight")
+        return ev
+
+    async def restart_b(ev: CrashEvent) -> None:
+        nonlocal b_proc, b, generation
+        try:
+            b_proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
+        generation += 1
+        t0 = time.monotonic()
+        b_proc = _spawn_child(cfg_path, b_report_path, state_dir, p,
+                              generation)
+        b = Child(b_proc)
+        await b.wait_for("READY", p.child_boot_timeout_s)
+        ev.restart_s = round(time.monotonic() - t0, 2)
+
+    try:
+        await b.wait_for("READY", p.child_boot_timeout_s)
+        sink_a: dict = {"replay": {}}
+        fp = FedSoakParams(
+            heartbeat_ms=p.heartbeat_ms,
+            trunk_timeout_ms=p.trunk_timeout_ms,
+            handover_timeout_ms=p.handover_timeout_ms,
+            global_tick_ms=p.global_tick_ms,
+        )
+        gw = await boot_gateway(
+            "a", fed_cfg, fp, stop,
+            settings_hook=wal_settings_hook("a", state_dir, p),
+            pre_start_hook=wal_pre_start_hook("a", state_dir, sink_a),
+        )
+        plane = gw["plane"]
+        ctl = gw["ctl"]
+        a_snap, _ = persistence_paths(state_dir, "a")
+        snap_task = asyncio.ensure_future(
+            snapshot_loop(a_snap, p.snapshot_interval_s)
+        )
+        await wait_trunk(plane, 15.0)
+        mark("trunk_up", leader=control.leader())
+
+        rng = Random(p.seed ^ 0xA)
+        sim = FedSim(ctl, rng)
+        sim.create_entities(p.base_entities, *XR["a"], *ZR, base=BASE["a"])
+        await b.cmd("spawn", n=p.base_entities)
+        estart = global_settings.entity_channel_id_start
+        expected_ids = {
+            str(estart + 1 + BASE[g] + i)
+            for g in ("a", "b") for i in range(p.base_entities)
+        }
+
+        async def wait_ledger(key: str, at_least: int,
+                              timeout: float) -> bool:
+            end = time.monotonic() + timeout
+            while time.monotonic() < end:
+                if plane.ledger.get(key, 0) >= at_least:
+                    return True
+                await asyncio.sleep(0.05)
+            return False
+
+        # Cross-gateway commits both ways: a's retention and b's applied
+        # registry both accumulate the durable reconciliation material.
+        sim.herd(sim.entity_ids[: p.committed_each_way], *XR["b"], *ZR)
+        if not await wait_ledger("committed", p.committed_each_way,
+                                 p.phase_timeout_s):
+            notes.append("pre-crash a->b commits incomplete")
+        await b.cmd("herd_to", gw="a", n=p.committed_each_way)
+        if not await wait_ledger("applied", 1, p.phase_timeout_s):
+            notes.append("pre-crash b->a handover never applied")
+        await b.cmd("flush_wal")
+        await asyncio.to_thread(wal.flush)
+        mark("traffic", committed=plane.ledger.get("committed", 0),
+             applied=plane.ledger.get("applied", 0))
+
+        # ---- crash 1: RECLAIMED (death never declared) ----
+        if "reclaim" in p.phases:
+            global_settings.global_death_miss_epochs = 100_000
+            ev = await kill_mid_burst(plane, sim, "reclaim")
+            crashes.append(ev)
+            mark("sigkill_reclaim", mid_burst=ev.mid_burst)
+            # Trunk down -> in-flight aborts restore on a.
+            deadline = time.monotonic() + p.phase_timeout_s
+            while time.monotonic() < deadline and any(
+                bt.peer == "b" for bt in plane._pending.values()
+            ):
+                await asyncio.sleep(0.1)
+            await restart_b(ev)
+            await wait_trunk(plane, p.phase_timeout_s)
+            # Resurrection resolves reclaimed; parked crossings re-offer.
+            deadline = time.monotonic() + p.phase_timeout_s
+            while time.monotonic() < deadline and \
+                    control.resurrections.get("peer_reclaimed", 0) < 1:
+                await asyncio.sleep(0.1)
+            if control.resurrections.get("peer_reclaimed", 0) < 1:
+                notes.append("no peer_reclaimed resurrection observed")
+            deadline = time.monotonic() + p.phase_timeout_s
+            while time.monotonic() < deadline and (
+                plane._pending or plane._parked
+            ):
+                await asyncio.sleep(0.1)
+            await b.cmd("quiesce", timeout=p.phase_timeout_s + 5.0,
+                        drain_s=p.phase_timeout_s)
+            await b.cmd("flush_wal")
+            await b.cmd("report", timeout=15.0)
+            with open(b_report_path) as f:
+                ev.replay = json.load(f)["persistence"]["replay"]
+            mark("reclaimed", restart_s=ev.restart_s,
+                 replay_s=ev.replay.get("elapsed_s"),
+                 resurrections=dict(control.resurrections))
+
+        # ---- crash 2: ADOPTED (torn WAL tail + death declaration) ----
+        if "adopt" in p.phases:
+            global_settings.global_death_miss_epochs = p.death_miss_epochs
+            await b.cmd("flush_wal")
+            await b.cmd("arm_torn")
+            ev = await kill_mid_burst(plane, sim, "adopt")
+            crashes.append(ev)
+            mark("sigkill_adopt", mid_burst=ev.mid_burst)
+            deadline = time.monotonic() + p.phase_timeout_s * 2
+            while time.monotonic() < deadline and "b" not in control.dead:
+                await asyncio.sleep(0.1)
+            if "b" not in control.dead:
+                raise RuntimeError(
+                    f"b never declared dead: {control.report()}"
+                )
+            deadline = time.monotonic() + p.phase_timeout_s
+            while time.monotonic() < deadline and control.adoptions < 1:
+                await asyncio.sleep(0.1)
+            mark("adopted_by_a", adoptions=control.adoptions,
+                 deaths=control.deaths)
+            await restart_b(ev)
+            await wait_trunk(plane, p.phase_timeout_s)
+            deadline = time.monotonic() + p.phase_timeout_s
+            while time.monotonic() < deadline and \
+                    control.resurrections.get("peer_yielded", 0) < 1:
+                await asyncio.sleep(0.1)
+            if control.resurrections.get("peer_yielded", 0) < 1:
+                notes.append("no peer_yielded resurrection observed")
+            # The yield hands over b's WAL-only entities; wait for the
+            # handovers (and any notice-driven purges) to drain.
+            deadline = time.monotonic() + p.phase_timeout_s
+            while time.monotonic() < deadline and (
+                plane._pending or plane._parked
+            ):
+                await asyncio.sleep(0.1)
+            await b.cmd("quiesce", timeout=p.phase_timeout_s + 5.0,
+                        drain_s=p.phase_timeout_s)
+            mark("yielded", restart_s=ev.restart_s,
+                 resurrections=dict(control.resurrections))
+
+        # ---- quiesce + census ----
+        qdeadline = time.monotonic() + p.phase_timeout_s
+        while time.monotonic() < qdeadline and (
+            plane._pending or plane._parked or journal.in_flight_count()
+        ):
+            await asyncio.sleep(0.1)
+        await asyncio.sleep(p.quiesce_s)
+        await b.cmd("report", timeout=15.0)
+        with open(b_report_path) as f:
+            b_report = json.load(f)
+        final_replay = b_report["persistence"]["replay"]
+        if crashes and not crashes[-1].replay:
+            crashes[-1].replay = final_replay
+
+        a_placement = local_placement()
+        b_placement = dict(b_report["placement"])
+        local_dups_a = a_placement.pop("__local_dups__", [])
+        local_dups_b = b_placement.pop("__local_dups__", [])
+        a_persist = persistence_report(baseline, sink_a["replay"])
+
+        inv = InvariantChecker()
+
+        # (a) one kill -9 crash per requested phase (the acceptance
+        # artifact runs both: >= 2, one reclaimed, one adopted).
+        inv.expect_le("two_crashes", len(p.phases), len(crashes),
+                      f"{len(crashes)} crashes, phases={p.phases}")
+        inv.check("both_kills_mid_handover_burst",
+                  all(ev.mid_burst for ev in crashes),
+                  str([(ev.phase, ev.mid_burst) for ev in crashes]))
+        if "reclaim" in p.phases:
+            inv.expect_gt("shard_reclaimed_after_restart",
+                          control.resurrections.get("peer_reclaimed", 0),
+                          0)
+        if "adopt" in p.phases:
+            # (b may have been discarded from the dead set already —
+            # its restart's trunk-up does that by design.)
+            inv.check("death_declared_and_adopted",
+                      control.deaths >= 1 and control.adoptions >= 1,
+                      f"deaths={control.deaths} "
+                      f"adoptions={control.adoptions}")
+            inv.expect_gt(
+                "shard_yielded_after_restart",
+                control.resurrections.get("peer_yielded", 0), 0,
+            )
+            b_res = b_report["persistence"]["resurrections"]
+            inv.expect_gt("b_counted_yielded",
+                          b_res.get("yielded", 0), 0, str(b_res))
+            # (b) the torn tail was replayed past truncation.
+            inv.check("torn_tail_replayed",
+                      bool(final_replay.get("torn")),
+                      str(final_replay))
+
+        # (c) zero committed entities lost or duplicated fleet-wide.
+        counts: dict[str, list] = {}
+        for eid, cell in a_placement.items():
+            counts.setdefault(eid, []).append(("a", cell))
+        for eid, cell in b_placement.items():
+            counts.setdefault(eid, []).append(("b", cell))
+        missing = sorted(e for e in expected_ids if e not in counts)
+        duplicated = {e: w for e, w in counts.items() if len(w) > 1}
+        unexpected = sorted(e for e in counts if e not in expected_ids)
+        inv.expect_equal(
+            "zero_committed_entities_lost_or_duplicated",
+            (missing, duplicated, unexpected, local_dups_a, local_dups_b),
+            ([], {}, [], [], []),
+        )
+
+        # (d) restart-to-serving within the deadlines: the replay work
+        # under wal_restart_deadline_s, the whole SIGKILL->READY wall
+        # under the soak's restart deadline (child boot included).
+        replay_ok = all(
+            (c.replay or final_replay).get("elapsed_s", 1e9)
+            <= global_settings.wal_restart_deadline_s for c in crashes
+        )
+        inv.check("replay_within_deadline", replay_ok,
+                  str([final_replay.get("elapsed_s")]))
+        inv.check(
+            "restart_to_serving_within_deadline",
+            all(0 < c.restart_s <= p.restart_deadline_s for c in crashes),
+            str([(c.phase, c.restart_s) for c in crashes]),
+        )
+
+        # (e) wal/resurrection ledgers == metrics on every gateway.
+        inv.expect_equal("a_wal_records_ledger_matches_metric",
+                         a_persist["metric"]["records"],
+                         a_persist["wal"]["record_counts"])
+        inv.expect_equal("a_wal_replayed_ledger_matches_metric",
+                         a_persist["metric"]["replayed"],
+                         a_persist["wal"]["replay_counts"])
+        inv.expect_equal("a_resurrection_ledger_matches_metric",
+                         a_persist["metric"]["resurrection"],
+                         a_persist["resurrections"])
+        b_persist = b_report["persistence"]
+        inv.expect_equal("b_wal_records_ledger_matches_metric",
+                         b_persist["metric"]["records"],
+                         b_persist["wal"]["record_counts"])
+        inv.expect_equal("b_wal_replayed_ledger_matches_metric",
+                         b_persist["metric"]["replayed"],
+                         b_persist["wal"]["replay_counts"])
+        inv.expect_equal("b_resurrection_ledger_matches_metric",
+                         b_persist["metric"]["resurrection"],
+                         b_persist["resurrections"])
+
+        # (f) nothing left in flight; journal balances.
+        inv.expect_equal(
+            "nothing_left_in_flight",
+            (len(plane._pending), len(plane._parked),
+             b_report["pending"], b_report["parked"],
+             journal.in_flight_count()),
+            (0, 0, 0, 0, 0),
+        )
+        jc = dict(journal.counts)
+        inv.expect_equal(
+            "journal_prepared_equals_committed_plus_aborted",
+            jc.get("prepared", 0),
+            jc.get("committed", 0) + jc.get("aborted", 0),
+            f"counts={jc}",
+        )
+
+        report = {
+            "kind": "crash_soak",
+            "duration_s": round(time.monotonic() - t_start, 2),
+            "entities": len(expected_ids),
+            "knobs": {
+                "fsync_ms": p.fsync_ms,
+                "snapshot_interval_s": p.snapshot_interval_s,
+                "epoch_ms": p.epoch_ms,
+                "death_miss_epochs": p.death_miss_epochs,
+                "restart_deadline_s": p.restart_deadline_s,
+                "wal_restart_deadline_s":
+                    global_settings.wal_restart_deadline_s,
+            },
+            "directory": fed_cfg,
+            "timeline": timeline,
+            "crashes": [
+                {"phase": c.phase, "mid_burst": c.mid_burst,
+                 "restart_s": c.restart_s,
+                 "replay_s": (c.replay or {}).get("elapsed_s"),
+                 "torn": bool((c.replay or {}).get("torn"))}
+                for c in crashes
+            ],
+            "replay": final_replay,
+            "resurrection": {
+                "a": a_persist["resurrections"],
+                "b": b_persist["resurrections"],
+                "counters": {
+                    k: v for k, v in control.counters.items()
+                    if k.startswith("resurrect")
+                },
+            },
+            "wal": {
+                "a": {"records": a_persist["wal"]["record_counts"],
+                      "replayed": a_persist["wal"]["replay_counts"]},
+                "b": {"records": b_persist["wal"]["record_counts"],
+                      "replayed": b_persist["wal"]["replay_counts"]},
+            },
+            "gateways": {
+                "a": {
+                    "ledger": dict(plane.ledger),
+                    "persistence": a_persist,
+                    "control": control.report(),
+                    "journal": journal.report(),
+                    "events": plane.events[-300:],
+                },
+                "b": {k: v for k, v in b_report.items()
+                      if k != "placement"},
+            },
+            "census": {
+                "expected": len(expected_ids),
+                "on_a": len(a_placement),
+                "on_b": len(b_placement),
+                "missing": missing,
+                "duplicated": {str(k): v for k, v in duplicated.items()},
+                "unexpected": unexpected,
+            },
+            "invariants": inv.summary(),
+        }
+        if notes:
+            report["notes"] = notes
+        if p.out_path:
+            with open(p.out_path, "w") as f:
+                json.dump(report, f, indent=2)
+        stop.set()
+        return report
+    finally:
+        stop.set()
+        if snap_task is not None:
+            snap_task.cancel()
+        try:
+            if b_proc.poll() is None:
+                try:
+                    b_proc.stdin.write('{"cmd": "exit"}\n')
+                    b_proc.stdin.flush()
+                except (BrokenPipeError, OSError):
+                    pass
+                try:
+                    b_proc.wait(timeout=8)
+                except subprocess.TimeoutExpired:
+                    b_proc.kill()
+        except Exception:
+            pass
+        if gw is not None:
+            teardown_gateway(gw)
+        for path in (cfg_path, b_report_path):
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+        if not p.state_dir:
+            shutil.rmtree(state_dir, ignore_errors=True)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--role", choices=("soak", "remote"), default="soak")
+    ap.add_argument("--config", type=str, default="")
+    ap.add_argument("--report", type=str, default="")
+    ap.add_argument("--state-dir", type=str, default="")
+    ap.add_argument("--seed", type=int, default=20260804)
+    ap.add_argument("--base-entities", type=int, default=12)
+    ap.add_argument("--kill-burst", type=int, default=8)
+    ap.add_argument("--phases", type=str, default="reclaim,adopt")
+    ap.add_argument("--epoch-ms", type=int, default=250)
+    ap.add_argument("--epoch-ms-b", type=int, default=10_000)
+    ap.add_argument("--heartbeat-ms", type=int, default=150)
+    ap.add_argument("--trunk-timeout-ms", type=int, default=900)
+    ap.add_argument("--handover-timeout-ms", type=int, default=1500)
+    ap.add_argument("--death-miss-epochs", type=int, default=4)
+    ap.add_argument("--fsync-ms", type=float, default=10.0)
+    ap.add_argument("--snapshot-interval-s", type=float, default=2.0)
+    ap.add_argument("--out", type=str, default="")
+    args = ap.parse_args()
+    if args.role == "remote":
+        asyncio.run(remote_main(args))
+        return
+    p = CrashSoakParams(
+        seed=args.seed, base_entities=args.base_entities,
+        kill_burst=args.kill_burst,
+        phases=tuple(s for s in args.phases.split(",") if s),
+        epoch_ms=args.epoch_ms, epoch_ms_b=args.epoch_ms_b,
+        heartbeat_ms=args.heartbeat_ms,
+        trunk_timeout_ms=args.trunk_timeout_ms,
+        handover_timeout_ms=args.handover_timeout_ms,
+        death_miss_epochs=args.death_miss_epochs,
+        fsync_ms=args.fsync_ms,
+        snapshot_interval_s=args.snapshot_interval_s,
+        out_path=args.out, state_dir=args.state_dir,
+    )
+    report = asyncio.run(run_crash_soak(p))
+    slim = dict(report)
+    slim["gateways"] = {
+        g: {k: v for k, v in r.items() if k != "events"}
+        for g, r in report["gateways"].items()
+    }
+    print(json.dumps(slim, indent=2))
+    if not report["invariants"]["ok"]:
+        raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -195,7 +195,8 @@ async def _drain(reader, stop: asyncio.Event) -> None:
 
 async def boot_gateway(gw_id: str, fed_cfg: dict, params: FedSoakParams,
                        stop: asyncio.Event, world: dict = None,
-                       expect_cells: int = 8, settings_hook=None):
+                       expect_cells: int = 8, settings_hook=None,
+                       pre_start_hook=None):
     """Fresh in-process gateway hosting ONE shard of the federated
     world: reset singletons, bring up listeners, master + one spatial
     server (the local block), arm the federation plane.
@@ -203,7 +204,11 @@ async def boot_gateway(gw_id: str, fed_cfg: dict, params: FedSoakParams,
     ``world``/``expect_cells`` override the default 4x4 two-shard
     geometry (scripts/global_soak.py boots a 3-shard world through this
     same path); ``settings_hook(global_settings)`` runs last, after the
-    soak defaults — the global soak re-enables the control plane there."""
+    soak defaults — the global soak re-enables the control plane there.
+    ``pre_start_hook()`` (optionally async) runs after the local shard
+    is up but BEFORE plane.start() — the crash soak replays
+    snapshot+WAL state there so the resurrection announce is armed
+    before the first trunk handshakes."""
     from channeld_tpu.core import channel as channel_mod
     from channeld_tpu.core import connection as connection_mod
     from channeld_tpu.core import data as data_mod
@@ -271,6 +276,11 @@ async def boot_gateway(gw_id: str, fed_cfg: dict, params: FedSoakParams,
     # recording and anomaly auto-dumps must not perturb either
     # (scripts/trace_soak.py is the recorder's own soak).
     global_settings.trace_enabled = False
+    # WAL pinned OFF (doc/persistence.md): journal appends + per-tick
+    # channel_state packing would perturb these soaks' deterministic
+    # envelopes (scripts/crash_soak.py is the persistence plane's own
+    # soak, and arms it through settings_hook).
+    global_settings.wal_path = ""
     from channeld_tpu.core.tracing import recorder as _flight_recorder
 
     _flight_recorder.configure(enabled=False)
@@ -369,6 +379,10 @@ async def boot_gateway(gw_id: str, fed_cfg: dict, params: FedSoakParams,
     else:
         raise RuntimeError(f"gateway {gw_id}: local shard failed to come up")
 
+    if pre_start_hook is not None:
+        result = pre_start_hook()
+        if asyncio.iscoroutine(result):
+            await result
     await plane.start()
     return {
         "ctl": ctl,
@@ -390,11 +404,13 @@ def teardown_gateway(gw) -> None:
     from channeld_tpu.core.failover import reset_failover
     from channeld_tpu.core.overload import reset_overload
     from channeld_tpu.core.settings import reset_global_settings
+    from channeld_tpu.core.wal import reset_wal
     from channeld_tpu.federation import reset_federation
     from channeld_tpu.spatial.balancer import reset_balancer
     from channeld_tpu.spatial.controller import reset_spatial_controller
 
     reset_federation()
+    reset_wal()
     for t in gw.get("tasks", []):
         t.cancel()
     for w in gw.get("writers", []):

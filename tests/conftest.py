@@ -62,9 +62,12 @@ def _fresh_globals(tmp_path):
 
     tracing.recorder.configure(dump_path=str(tmp_path))
     yield
+    from channeld_tpu.core import wal as wal_mod
+
     events.reset_all()
     settings.reset_global_settings()
     overload.reset_overload()
     balancer_mod.reset_balancer()
     device_guard.reset_device_guard()
     tracing.reset_tracing()
+    wal_mod.reset_wal()

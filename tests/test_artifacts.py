@@ -136,6 +136,77 @@ def test_device_soak_schema_gate(tmp_path):
                for e in check_artifacts.check_artifacts(str(tmp_path)))
 
 
+def _crash_soak_doc():
+    return {
+        "kind": "crash_soak",
+        "invariants": {"ok": True, "checks": [
+            {"name": n, "ok": True} for n in (
+                "two_crashes",
+                "both_kills_mid_handover_burst",
+                "zero_committed_entities_lost_or_duplicated",
+                "restart_to_serving_within_deadline",
+                "replay_within_deadline",
+                "torn_tail_replayed",
+                "shard_reclaimed_after_restart",
+                "shard_yielded_after_restart",
+                "a_wal_records_ledger_matches_metric",
+            )
+        ]},
+        "crashes": [
+            {"phase": "reclaim", "mid_burst": True, "restart_s": 0.5,
+             "torn": False},
+            {"phase": "adopt", "mid_burst": True, "restart_s": 0.5,
+             "torn": True},
+        ],
+        "replay": {"torn": True, "elapsed_s": 0.01},
+        "resurrection": {"a": {"peer_yielded": 1}, "b": {"yielded": 1}},
+        "wal": {"a": {}, "b": {}},
+        "census": {"expected": 24, "missing": [], "duplicated": {},
+                   "unexpected": []},
+    }
+
+
+def test_crash_soak_schema_gate(tmp_path):
+    """SOAK_CRASH_*.json extra checks (doc/persistence.md): a clean
+    artifact passes; fewer than two crashes, missing phase coverage, no
+    torn-tail replay, a dirty census, and a missing invariant name are
+    each flagged."""
+    import json
+
+    path = tmp_path / "SOAK_CRASH_r99.json"
+    path.write_text(json.dumps(_crash_soak_doc()))
+    assert check_artifacts.check_artifacts(str(tmp_path)) == []
+
+    doc = _crash_soak_doc()
+    doc["crashes"] = doc["crashes"][:1]
+    path.write_text(json.dumps(doc))
+    errors = check_artifacts.check_artifacts(str(tmp_path))
+    assert any("fewer than 2 crashes" in e for e in errors)
+    assert any("missing reclaim/adopt coverage" in e for e in errors)
+
+    doc = _crash_soak_doc()
+    for c in doc["crashes"]:
+        c["torn"] = False
+    path.write_text(json.dumps(doc))
+    assert any("no crash replayed a torn WAL tail" in e
+               for e in check_artifacts.check_artifacts(str(tmp_path)))
+
+    doc = _crash_soak_doc()
+    doc["census"]["duplicated"] = {"524289": [["a", 1], ["b", 2]]}
+    path.write_text(json.dumps(doc))
+    assert any("crash census not clean" in e
+               for e in check_artifacts.check_artifacts(str(tmp_path)))
+
+    doc = _crash_soak_doc()
+    doc["invariants"]["checks"] = [
+        c for c in doc["invariants"]["checks"]
+        if c["name"] != "torn_tail_replayed"
+    ]
+    path.write_text(json.dumps(doc))
+    assert any("missing invariant check 'torn_tail_replayed'" in e
+               for e in check_artifacts.check_artifacts(str(tmp_path)))
+
+
 def test_artifact_metric_refs_are_checked():
     """Committed artifacts citing metrics must cite registered families
     with the declared label sets (scripts/check_artifacts.py

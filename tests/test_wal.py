@@ -1,0 +1,591 @@
+"""Durable write-ahead journal (core/wal.py, doc/persistence.md): CRC
+framing, torn-tail truncation, the corrupt-durability matrix, boot
+replay over snapshots in both orderings, blacklist/journal/staged-state
+persistence, the skip-unchanged snapshot loop, the resurrection census
+reconciliation, and the <60s crash-restart smoke soak."""
+
+import asyncio
+import os
+import struct
+import subprocess
+import sys
+import time
+import zlib
+
+import pytest
+
+from channeld_tpu.chaos import arm as chaos_arm, disarm as chaos_disarm
+from channeld_tpu.core.channel import (
+    create_channel,
+    create_entity_channel,
+    get_channel,
+    get_global_channel,
+    remove_channel,
+)
+from channeld_tpu.core.settings import global_settings
+from channeld_tpu.core.snapshot import (
+    save_snapshot,
+    snapshot_digest,
+    snapshot_loop,
+    sweep_stale_tmp,
+    take_snapshot,
+    write_snapshot,
+)
+from channeld_tpu.core.types import ChannelType
+from channeld_tpu.core.wal import (
+    MAGIC,
+    boot_replay,
+    read_wal_records,
+    reset_wal,
+    wal,
+)
+from channeld_tpu.models import testdata_pb2
+from channeld_tpu.protocol import wal_pb2
+
+from helpers import fresh_runtime
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def runtime():
+    fresh_runtime()
+    reset_wal()
+    yield
+    chaos_disarm()
+    reset_wal()
+
+
+def _start(tmp_path, fsync_ms: float = 1.0) -> str:
+    global_settings.wal_fsync_ms = fsync_ms
+    path = str(tmp_path / "gw.wal")
+    wal.start(path)
+    return path
+
+
+def _mk_channel(text: str = "hello", num: int = 1):
+    ch = create_channel(ChannelType.SUBWORLD, None)
+    ch.init_data(testdata_pb2.TestChannelDataMessage(text=text, num=num),
+                 None)
+    return ch
+
+
+def _drain_dirty():
+    """Run the GLOBAL tick's WAL drain (channel.tick_once wiring)."""
+    get_global_channel().tick_once()
+
+
+# ---------------------------------------------------------------------------
+# framing + the corrupt-durability matrix
+# ---------------------------------------------------------------------------
+
+
+def test_append_flush_read_roundtrip(tmp_path):
+    path = _start(tmp_path)
+    wal.log_flip([7, 8], 0x10001)
+    wal.log_blacklist("ip", "10.0.0.1")
+    assert wal.flush()
+    records, torn = read_wal_records(path)
+    assert not torn
+    assert [r.kind for r in records] == ["flip", "blacklist"]
+    assert list(records[0].entityIds) == [7, 8]
+    assert records[0].seq == 1 and records[1].seq == 2
+    # Ledger == what we'd scrape: one record per kind.
+    assert wal.record_counts == {"flip": 1, "blacklist": 1}
+
+
+def test_torn_tail_truncated_and_replayable(tmp_path):
+    """Matrix: truncated WAL tail — a partial final frame (power loss
+    mid-append) is truncated at the tear; the committed prefix replays."""
+    path = _start(tmp_path)
+    wal.log_flip([1], 0x10001)
+    wal.log_flip([2], 0x10002)
+    assert wal.flush()
+    wal.stop()
+    with open(path, "ab") as f:
+        f.write(struct.pack("<II", 99, 0xDEAD) + b"partial")
+    records, torn = read_wal_records(path)
+    assert torn and len(records) == 2
+    # The truncation is durable: a second scan is clean.
+    records2, torn2 = read_wal_records(path)
+    assert not torn2 and len(records2) == 2
+
+
+def test_bad_crc_mid_file_truncates_there(tmp_path):
+    """Matrix: bad-CRC mid-file — records after the corruption are
+    unrecoverable by construction; everything before it replays."""
+    path = _start(tmp_path)
+    for i in range(4):
+        wal.log_flip([i], 0x10000 + i)
+    assert wal.flush()
+    wal.stop()
+    # Corrupt one payload byte of the SECOND record.
+    blob = open(path, "rb").read()
+    off = len(MAGIC)
+    ln, _crc = struct.unpack_from("<II", blob, off)
+    second = off + 8 + ln  # start of record 2's frame
+    mutate = second + 8  # first payload byte
+    blob = blob[:mutate] + bytes([blob[mutate] ^ 0xFF]) + blob[mutate + 1:]
+    with open(path, "wb") as f:
+        f.write(blob)
+    records, torn = read_wal_records(path)
+    assert torn and len(records) == 1
+    assert records[0].entityIds[0] == 0
+
+
+def test_zero_length_and_missing_wal(tmp_path):
+    """Matrix: zero-length WAL (crash between create and header) and a
+    missing file are both an empty journal, never an error."""
+    empty = str(tmp_path / "empty.wal")
+    open(empty, "wb").close()
+    assert read_wal_records(empty) == ([], False)
+    assert read_wal_records(str(tmp_path / "missing.wal")) == ([], False)
+    # Header-only file: armed then killed before the first record.
+    header_only = str(tmp_path / "header.wal")
+    with open(header_only, "wb") as f:
+        f.write(MAGIC)
+    assert read_wal_records(header_only) == ([], False)
+
+
+def test_corrupt_header_quarantined_not_appended_after(tmp_path):
+    """Matrix hardening: a journal whose magic header is gone must not
+    become a durability black hole — start() quarantines it and opens a
+    fresh journal, so new records are replayable."""
+    path = str(tmp_path / "gw.wal")
+    with open(path, "wb") as f:
+        f.write(b"NOTMAGIC" + b"junk" * 8)
+    global_settings.wal_fsync_ms = 1.0
+    wal.start(path)
+    wal.log_flip([42], 0x10001)
+    assert wal.flush()
+    records, torn = read_wal_records(path)
+    assert not torn and len(records) == 1
+    assert any(".corrupt." in n for n in os.listdir(tmp_path))
+
+
+def test_stale_tmp_snapshot_leftovers_swept(tmp_path):
+    """Matrix: stale ``.tmp`` snapshot residue from a kill -9 between
+    the tmp write and the rename is swept at boot and never read."""
+    snap_path = str(tmp_path / "gw.snap")
+    _mk_channel("real")
+    save_snapshot(snap_path)
+    for i in range(3):
+        with open(f"{snap_path}.tmp.999.{i}", "wb") as f:
+            f.write(b"\xff\xfegarbage")
+    assert sweep_stale_tmp(snap_path) == 3
+    assert not any(".tmp." in n for n in os.listdir(tmp_path))
+    # boot_replay sweeps too (the kill -9 restart path).
+    with open(f"{snap_path}.tmp.998.0", "wb") as f:
+        f.write(b"junk")
+    fresh_runtime()
+    report = boot_replay(snap_path, str(tmp_path / "gw.wal"))
+    assert report["snapshot_channels"] >= 1
+    assert not any(".tmp." in n for n in os.listdir(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# boot replay: channel images, tombstones, both orderings
+# ---------------------------------------------------------------------------
+
+
+def test_replay_channel_states_and_tombstones(tmp_path):
+    path = _start(tmp_path)
+    keep = _mk_channel("keep", 1)
+    doomed = _mk_channel("doomed", 2)
+    _drain_dirty()  # init_data marked both dirty
+    # Mutate through the real queue path, then remove one.
+    keep.execute(lambda c: c.data.on_update(
+        testdata_pb2.TestChannelDataMessage(text="mutated"), 0, 1, None))
+    keep.tick_once()
+    remove_channel(doomed)
+    _drain_dirty()
+    assert wal.flush()
+    keep_id, doomed_id = keep.id, doomed.id
+
+    fresh_runtime()
+    report = boot_replay("", path)
+    assert report["wal_records"] > 0 and not report["torn"]
+    restored = get_channel(keep_id)
+    assert restored is not None
+    assert restored.get_data_message().text == "mutated"
+    assert get_channel(doomed_id) is None
+    assert wal.replay_counts.get("channel_state", 0) >= 1
+    assert wal.replay_counts.get("channel_removed", 0) >= 1
+
+
+def test_wal_newer_than_snapshot(tmp_path):
+    """Ordering matrix: records appended AFTER the snapshot replay on
+    top of it (the normal crash case)."""
+    wal_path = _start(tmp_path)
+    snap_path = str(tmp_path / "gw.snap")
+    ch = _mk_channel("v1")
+    _drain_dirty()
+    assert wal.flush()
+    save_snapshot(snap_path)  # covers seq so far (walSeq stamped)
+    ch.execute(lambda c: c.data.on_update(
+        testdata_pb2.TestChannelDataMessage(text="v2"), 0, 1, None))
+    ch.tick_once()
+    _drain_dirty()
+    assert wal.flush()
+    cid = ch.id
+
+    fresh_runtime()
+    report = boot_replay(snap_path, wal_path)
+    assert get_channel(cid).get_data_message().text == "v2"
+    # Only the post-snapshot tail replayed.
+    assert report["wal_records"] < wal.record_counts.get("channel_state", 99)
+
+
+def test_snapshot_newer_than_wal(tmp_path):
+    """Ordering matrix: a snapshot taken AFTER the journal's last record
+    (e.g. the shutdown drain's final write raced an unsynced journal)
+    must win — replay filters records at or below walSeq instead of
+    regressing the newer snapshot state."""
+    wal_path = _start(tmp_path)
+    snap_path = str(tmp_path / "gw.snap")
+    ch = _mk_channel("old")
+    _drain_dirty()
+    assert wal.flush()  # journal holds the "old" image
+    # State moves on; the snapshot captures the NEWER state and stamps
+    # walSeq at the current sequence.
+    ch.execute(lambda c: c.data.on_update(
+        testdata_pb2.TestChannelDataMessage(text="newer"), 0, 1, None))
+    ch.tick_once()
+    save_snapshot(snap_path)
+    cid = ch.id
+
+    fresh_runtime()
+    report = boot_replay(snap_path, wal_path)
+    assert get_channel(cid).get_data_message().text == "newer"
+    assert report["wal_records"] == 0  # everything was snapshot-covered
+
+
+def test_checkpoint_truncates_covered_records(tmp_path):
+    path = _start(tmp_path)
+    snap_path = str(tmp_path / "gw.snap")
+    _mk_channel("a")
+    _drain_dirty()
+    assert wal.flush()
+    save_snapshot(snap_path)  # checkpoints at walSeq
+    wal.log_flip([9], 0x10001)  # post-checkpoint record
+    assert wal.flush()
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        records, _ = read_wal_records(path, truncate=False)
+        if len(records) == 1 and records[0].kind == "flip":
+            break
+        time.sleep(0.02)
+    records, _ = read_wal_records(path, truncate=False)
+    assert [r.kind for r in records] == ["flip"]
+
+
+# ---------------------------------------------------------------------------
+# non-channel durable state
+# ---------------------------------------------------------------------------
+
+
+def test_blacklists_survive_restart(tmp_path):
+    """Satellite regression: anti-DDoS blacklists persist across a
+    crash-restart via BOTH paths — WAL records and snapshot extras — so
+    a kill -9 does not hand attackers a clean slate."""
+    from channeld_tpu.core import ddos
+
+    wal_path = _start(tmp_path)
+    snap_path = str(tmp_path / "gw.snap")
+    ddos.ban_ip("203.0.113.7")
+    ddos.ban_pit("evil-pit")
+    assert wal.flush()
+    save_snapshot(snap_path)
+    ddos.ban_ip("203.0.113.8")  # post-snapshot: WAL-only
+    assert wal.flush()
+
+    fresh_runtime()  # resets ddos too
+    assert not ddos.is_ip_banned("203.0.113.7")
+    boot_replay(snap_path, wal_path)
+    assert ddos.is_ip_banned("203.0.113.7")
+    assert ddos.is_ip_banned("203.0.113.8")
+    assert ddos.is_pit_banned("evil-pit")
+
+    # Snapshot-only boot path (WAL disabled) restores them too.
+    fresh_runtime()
+    from channeld_tpu.core.snapshot import boot_restore
+
+    boot_restore(snap_path)
+    assert ddos.is_ip_banned("203.0.113.7")
+    assert ddos.is_pit_banned("evil-pit")
+
+
+def test_staged_handles_and_journal_inflight_replay(tmp_path):
+    """A staged recovery handle and an in-flight (prepared, never
+    committed) handover record both survive the crash: the handle
+    re-stages and the entity restores to its SRC cell — unless a
+    replayed cell image already holds the row (the dst add landed but
+    its commit record was lost to the fsync window), in which case
+    restoring would duplicate it."""
+    from channeld_tpu.core.connection_recovery import (
+        _recover_handles,
+        stage_recovery_handle,
+    )
+    from channeld_tpu.core.failover import journal
+    from channeld_tpu.models import sim_pb2
+    from channeld_tpu.models.sim import register_sim_types
+
+    register_sim_types()
+    wal_path = _start(tmp_path)
+    src = create_channel(ChannelType.SPATIAL, None)
+    src.init_data(None, None)
+    _drain_dirty()
+    stage_recovery_handle("crash-pit", [src.id])
+    eid = global_settings.entity_channel_id_start + 5
+    ech = create_entity_channel(eid, None)
+    data = sim_pb2.SimEntityChannelData()
+    data.state.entityId = eid
+    ech.init_data(data, None)
+    journal.prepare({eid: data}, src.id, src.id + 1, remote=True)
+    _drain_dirty()
+    assert wal.flush()
+    src_id = src.id
+
+    fresh_runtime()
+    register_sim_types()
+    report = boot_replay("", wal_path)
+    assert "crash-pit" in _recover_handles
+    assert _recover_handles["crash-pit"].staged
+    assert report["in_flight_resolved"] == 1
+    assert eid in report["restored_entities"]
+    restored_src = get_channel(src_id)
+    # The restoring re-add rides the src channel's queue.
+    restored_src.tick_once()
+    ents = getattr(restored_src.get_data_message(), "entities", None)
+    assert ents is not None and eid in ents
+
+
+def test_inflight_not_restored_when_row_already_lives_somewhere(tmp_path):
+    """The dst add landed (its cell image holds the row) but the commit
+    record was lost: replay must NOT also restore to src."""
+    from channeld_tpu.core.failover import journal
+    from channeld_tpu.models import sim_pb2
+    from channeld_tpu.models.sim import register_sim_types
+
+    register_sim_types()
+    wal_path = _start(tmp_path)
+    src = create_channel(ChannelType.SPATIAL, None)
+    src.init_data(None, None)
+    dst = create_channel(ChannelType.SPATIAL, None)
+    dst.init_data(None, None)
+    eid = global_settings.entity_channel_id_start + 6
+    ech = create_entity_channel(eid, None)
+    data = sim_pb2.SimEntityChannelData()
+    data.state.entityId = eid
+    ech.init_data(data, None)
+    journal.prepare({eid: data}, src.id, dst.id)
+    dst.execute(lambda c: c.get_data_message().add_entity(eid, data))
+    dst.tick_once()
+    _drain_dirty()
+    assert wal.flush()
+    src_id, dst_id = src.id, dst.id
+
+    fresh_runtime()
+    register_sim_types()
+    boot_replay("", wal_path)
+    rsrc, rdst = get_channel(src_id), get_channel(dst_id)
+    rsrc.tick_once()
+    src_ents = getattr(rsrc.get_data_message(), "entities", {})
+    dst_ents = getattr(rdst.get_data_message(), "entities", {})
+    assert eid in dst_ents and eid not in src_ents  # exactly one copy
+
+
+def test_torn_write_chaos_wedges_writer_but_prefix_replays(tmp_path):
+    """Chaos ``wal.torn_write``: the record under write tears and
+    NOTHING after it reaches disk (simulated power loss) — replay
+    truncates at the bad CRC and the committed prefix survives."""
+    path = _start(tmp_path)
+    wal.log_flip([1], 0x10001)
+    assert wal.flush()
+    chaos_arm({"seed": 7, "faults": [
+        {"point": "wal.torn_write", "every_n": 1, "max_fires": 1},
+    ]})
+    wal.log_flip([2], 0x10002)  # tears mid-write, wedges the writer
+    wal.log_flip([3], 0x10003)  # discarded (power is "off")
+    # A checkpoint after the wedge must not run either: its rewrite
+    # would heal the torn tail post-"power loss".
+    wal.checkpoint(1)
+    wal.flush()
+    time.sleep(0.1)
+    wal.stop(flush=False)
+    records, torn = read_wal_records(path, truncate=False)
+    assert torn
+    assert [r.entityIds[0] for r in records] == [1]
+
+
+def test_fsync_stall_never_blocks_append(tmp_path):
+    """Chaos ``wal.fsync_stall``: a slow disk stalls the WRITER thread;
+    the tick-path append must stay microseconds."""
+    _start(tmp_path, fsync_ms=1.0)
+    chaos_arm({"seed": 7, "faults": [
+        {"point": "wal.fsync_stall", "every_n": 1, "stall_ms": 300},
+    ]})
+    t0 = time.monotonic()
+    for i in range(50):
+        wal.log_flip([i], 0x10001)
+    append_s = time.monotonic() - t0
+    assert append_s < 0.1, f"appends blocked {append_s:.3f}s"
+    assert wal.flush(timeout_s=10.0)
+
+
+# ---------------------------------------------------------------------------
+# skip-unchanged periodic snapshots (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_snapshot_digest_ignores_taken_at_and_walseq():
+    _mk_channel("same")
+    s1 = take_snapshot()
+    time.sleep(0.01)
+    s2 = take_snapshot()
+    s2.walSeq = 999
+    s2.takenAt = s1.takenAt + 100
+    assert snapshot_digest(s1) == snapshot_digest(s2)
+
+
+def test_snapshot_loop_skips_unchanged_writes(tmp_path):
+    """Satellite: an idle gateway pays one pack+hash per interval and
+    zero disk traffic; a mutation triggers exactly one new write."""
+    from channeld_tpu.chaos.invariants import delta, scrape
+
+    ch = _mk_channel("idle")
+    path = str(tmp_path / "periodic.snap")
+    baseline = scrape()
+
+    async def drive():
+        task = asyncio.ensure_future(snapshot_loop(path, interval_s=0.0))
+        try:
+            deadline = asyncio.get_running_loop().time() + 10.0
+            while not os.path.exists(path):
+                await asyncio.sleep(0.05)
+                assert asyncio.get_running_loop().time() < deadline
+            first_mtime = os.path.getmtime(path)
+            # Two more cycles with no change: file must not rewrite.
+            await asyncio.sleep(2.2)
+            assert os.path.getmtime(path) == first_mtime
+            # Mutate -> next cycle writes.
+            ch.data.on_update(
+                testdata_pb2.TestChannelDataMessage(text="busy"), 0, 1,
+                None,
+            )
+            deadline = asyncio.get_running_loop().time() + 10.0
+            while os.path.getmtime(path) == first_mtime:
+                await asyncio.sleep(0.05)
+                assert asyncio.get_running_loop().time() < deadline
+        finally:
+            task.cancel()
+
+    asyncio.run(drive())
+    d = delta(scrape(), baseline)
+    written = d.get(("snapshot_writes_total", (("result", "written"),)), 0)
+    skipped = d.get(("snapshot_writes_total", (("result", "skipped"),)), 0)
+    assert written == 2 and skipped >= 1
+
+
+# ---------------------------------------------------------------------------
+# resurrection census reconciliation (receiver side, unit)
+# ---------------------------------------------------------------------------
+
+
+def test_resurrect_hello_restores_fsync_window_losses():
+    """A batch committed INTO the returnee whose apply died in its final
+    fsync window: the returnee's hello census misses the entity and the
+    receiver restores it from commit retention (reclaim path — nothing
+    else would ever bring it back)."""
+    from channeld_tpu.core.failover import HandoverRecord
+    from channeld_tpu.federation.control import control, reset_global_control
+    from channeld_tpu.federation.directory import directory
+    from channeld_tpu.federation.plane import PendingBatch
+    from channeld_tpu.models.sim import register_sim_types
+    from channeld_tpu.protocol import control_pb2
+    from channeld_tpu.core.data import register_channel_data_type
+
+    register_sim_types()
+    reset_global_control()
+    directory.load_dict(
+        {"secret": "", "gateways": {
+            "a": {"trunk": "127.0.0.1:1", "client": "", "servers": [0]},
+            "b": {"trunk": "127.0.0.1:2", "client": "", "servers": [1]},
+        }},
+        "a",
+    )
+    control.active = True
+    cell = create_channel(ChannelType.SPATIAL, None)
+    cell.init_data(None, None)
+    eid = global_settings.entity_channel_id_start + 77
+    from channeld_tpu.models import sim_pb2
+
+    data = sim_pb2.SimEntityChannelData()
+    data.state.entityId = eid
+    rec = HandoverRecord(1, eid, cell.id, cell.id + 1, data,
+                         state="committed", remote=True)
+    batch = PendingBatch(
+        batch_id=1, peer="b", src_channel_id=cell.id,
+        dst_channel_id=cell.id + 1, records=[rec], entities={eid: data},
+        deadline=0.0,
+    )
+    control.note_batch_committed(batch)
+    hello = control_pb2.TrunkResurrectHelloMessage(
+        gatewayId="b", cellIds=[cell.id + 1], entityIds=[],  # census: lost
+    )
+    control._on_resurrect_hello("b", hello)
+    cell.tick_once()  # the restore's add rides the cell queue
+    ents = getattr(cell.get_data_message(), "entities", {})
+    assert eid in ents
+    assert control.counters.get("resurrect_fsync_window_restored") == 1
+    assert control.resurrections.get("peer_reclaimed") == 1
+    # Census-race guard: an entity whose replayed in-flight re-add is
+    # still queued rides the announce census anyway (its channel
+    # exists), so a reclaim peer can't double-restore it.
+    qid = global_settings.entity_channel_id_start + 78
+    create_entity_channel(qid, None)
+    control.arm_resurrection(0, restored_entities=[qid])
+    _cells, census_ents = control._resurrect_census()
+    assert qid in census_ents
+    reset_global_control()
+    directory.reset()
+
+
+# ---------------------------------------------------------------------------
+# the <60s crash-restart smoke soak (tier-1)
+# ---------------------------------------------------------------------------
+
+
+def test_crash_smoke_soak():
+    """Real two-process crash soak, adopted-crash phase only, small
+    numbers: SIGKILL mid-handover-burst with a torn WAL append, death
+    declaration + adoption, restart + replay past the torn tail,
+    resurrection yield, exact census, ledgers == metrics."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "crash_soak.py"),
+         "--phases", "adopt", "--base-entities", "6", "--kill-burst", "4",
+         "--epoch-ms", "200", "--death-miss-epochs", "3",
+         "--snapshot-interval-s", "1.0"],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, (
+        f"crash smoke soak failed:\n{proc.stdout[-4000:]}\n"
+        f"{proc.stderr[-2000:]}"
+    )
+
+
+@pytest.mark.slow
+def test_crash_full_soak(tmp_path):
+    """The full acceptance soak (both crash phases) — the artifact
+    generator for SOAK_CRASH_*.json."""
+    out = str(tmp_path / "SOAK_CRASH.json")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "crash_soak.py"),
+         "--out", out],
+        cwd=REPO, capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, (
+        f"crash soak failed:\n{proc.stdout[-4000:]}\n{proc.stderr[-2000:]}"
+    )
