@@ -5,6 +5,7 @@ from .async_blocking import AsyncBlockingRule
 from .excepts import ExceptHygieneRule
 from .proto_drift import ProtoDriftRule
 from .readback import HotPathReadbackRule
+from .units import HistogramUnitsRule
 
 ALL_RULES = (
     ProtoDriftRule,
@@ -12,6 +13,7 @@ ALL_RULES = (
     HotPathReadbackRule,
     DoubleEntryRule,
     ExceptHygieneRule,
+    HistogramUnitsRule,
 )
 
 

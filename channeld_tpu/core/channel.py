@@ -33,6 +33,7 @@ from .data import (
 )
 from .overload import governor as _governor
 from .settings import global_settings
+from .slo import slo as _slo
 from .tracing import recorder as _trace
 from .wal import wal as _wal
 from .types import BroadcastType, ChannelType, ConnectionType, GLOBAL_CHANNEL_ID, MessageType
@@ -222,13 +223,15 @@ class Channel:
     # ---- message queue ---------------------------------------------------
 
     def put_message(self, msg, handler, conn, pack, raw_body=None,
-                    external: bool = False) -> bool:
+                    external: bool = False, ingest_ns: int = 0) -> bool:
         """Enqueue from any task; handled in this channel's tick
         (ref: channel.go:295-310). ``raw_body`` carries the inbound bytes
         through for pure forwards so the send side need not re-encode.
-        False = queue full: NOT enqueued, NOT dropped — the caller must
-        stash and retry after backpressure drains (connection.on_bytes
-        does)."""
+        ``ingest_ns`` is the connection-read monotonic stamp the
+        delivery-SLO plane threads through to the fan-out (core/slo.py;
+        0 = internal/unstamped). False = queue full: NOT enqueued, NOT
+        dropped — the caller must stash and retry after backpressure
+        drains (connection.on_bytes does)."""
         if self.is_removing():
             return True  # channel dying: message vanishes, like the ref
         global _MessageContext
@@ -244,10 +247,12 @@ class Channel:
             channel_id=pack.channelId,
             arrival_time=self.get_time(),
             raw_body=raw_body,
+            ingest_ns=ingest_ns,
         )
         return self._enqueue(_QueuedMessage(ctx, handler), external=external)
 
-    def put_forward_batch(self, entries: list, conn) -> bool:
+    def put_forward_batch(self, entries: list, conn,
+                          ingest_ns: int = 0) -> bool:
         """Enqueue one batched-ingest run (pre-encoded owner send-queue
         entries from the native parse_forward path) as a single queue
         item. Semantics match N put_message calls whose handler is
@@ -262,12 +267,14 @@ class Channel:
         ctx = _MessageContext(connection=conn, channel=self)
         return self._enqueue(
             _QueuedMessage(
-                ctx, lambda _ctx, e=entries: self._deliver_forward_batch(e)
+                ctx, lambda _ctx, e=entries, t=ingest_ns:
+                    self._deliver_forward_batch(e, t)
             ),
             external=True,
         )
 
-    def _deliver_forward_batch(self, entries: list) -> None:
+    def _deliver_forward_batch(self, entries: list,
+                               ingest_ns: int = 0) -> None:
         owner = self.get_owner()
         if owner is not None and not owner.is_closing():
             if owner.should_recover():
@@ -281,6 +288,12 @@ class Channel:
             # Resolve the set through the module: drain_pending_flush
             # swaps in a fresh set every pump cycle.
             _connection_mod._pending_flush.add(owner)
+            if _slo.enabled and ingest_ns:
+                # The batched fast path's delivery point: the run just
+                # landed on the owner's send queue (flushed this pump
+                # cycle). Stamp carried from the OLDEST read folded in.
+                _slo.record_delivery(self.channel_type.name, "fast",
+                                     ingest_ns)
         else:
             # Every drop is counted (failover keys alerts off this);
             # the log stays rate-limited like the per-message path.
@@ -500,6 +513,10 @@ class Channel:
         elapsed = time.monotonic() - tick_start
         self._m_tick_duration.observe(elapsed)
         _governor.note_tick(elapsed, self.tick_interval)
+        if _slo.enabled and self.tick_interval > 0:
+            # Budget-utilization event for the tick_budget SLO (>1.0 ==
+            # the tick overran its interval; core/slo.py).
+            _slo.observe("tick_budget", elapsed / self.tick_interval)
         if self.channel_type == ChannelType.SPATIAL:
             # Per-server load attribution for the balancer: this cell's
             # tick cost lands on its owner server's pressure ledger.
@@ -510,6 +527,11 @@ class Channel:
             gov_start = time.monotonic_ns()
             _governor.update(self.tick_interval)
             _trace.stage("overload", gov_start, lane=self.id)
+            if _slo.enabled:
+                # Burn-rate evaluation + the round-robin staleness
+                # sample, inside the GLOBAL tick's single-writer
+                # context (doc/observability.md).
+                _slo.on_global_tick()
             if _wal.enabled:
                 # Drain the dirty set into journal records — inside the
                 # GLOBAL tick, the same single-writer context the epoch

@@ -67,14 +67,19 @@ class _ForwardBatch:
     """One batched-ingest run: pre-encoded owner send-queue entries for
     plain user-space forwards to GLOBAL, produced by the native codec's
     parse_forward. Travels through receive_message / the pending stash
-    like a MessagePack so ordering and backpressure semantics hold."""
+    like a MessagePack so ordering and backpressure semantics hold.
+    ``ingest_ns`` is the monotonic stamp of the OLDEST read folded into
+    the run — the delivery-SLO plane (core/slo.py) measures the held
+    batch's true age, stash-and-retry included."""
 
-    __slots__ = ("entries", "counts", "n_packets")
+    __slots__ = ("entries", "counts", "n_packets", "ingest_ns")
 
-    def __init__(self, entries: list, counts: dict, n_packets: int):
+    def __init__(self, entries: list, counts: dict, n_packets: int,
+                 ingest_ns: int = 0):
         self.entries = entries
         self.counts = counts  # msgType -> n, for metrics attribution
         self.n_packets = n_packets
+        self.ingest_ns = ingest_ns
 
 
 class Transport(Protocol):
@@ -193,9 +198,15 @@ class Connection:
         # (channel_type, msgType) -> count since the last publish; see
         # _publish_msg_received.
         self._msg_received_pending: dict[tuple, int] = {}
-        # Deferred fast-path run [entries, counts, n_packets]; dispatched
-        # by flush_ingest (1ms pump / channel tick / ordering points).
+        # Deferred fast-path run [entries, counts, n_packets,
+        # ingest_ns]; dispatched by flush_ingest (1ms pump / channel
+        # tick / ordering points).
         self._fast_run: Optional[list] = None
+        # Monotonic stamp of the read currently being dispatched; the
+        # delivery-SLO ingest mark every receive_message of this read
+        # inherits (flush_pending restores each stashed message's own
+        # original stamp before re-dispatch).
+        self._rx_stamp_ns = 0
         if self._is_packet_recording_enabled():
             from ..replay.session import ReplaySession
 
@@ -226,6 +237,12 @@ class Connection:
             self.compression_type = CompressionType.SNAPPY
         if not bodies:
             return
+        # One ingest stamp per read batch: the delivery-SLO mark every
+        # message of this read carries (core/slo.py). monotonic_ns is
+        # ~40ns; stamping per read (not per message) keeps the 10K-conn
+        # singleton-read floor untouched.
+        rx_ns = time.monotonic_ns()
+        self._rx_stamp_ns = rx_ns
         recording = (self._is_packet_recording_enabled()
                      and self.replay_session is not None)
         # The batched ingest path: packets that are nothing but plain
@@ -266,7 +283,8 @@ class Connection:
                             # traffic through protobuf was the dominant
                             # overload-regime cost in the r5 profile.
                             pending_msgs.append(
-                                (_ForwardBatch(res[0], res[1], 1), [False])
+                                (_ForwardBatch(res[0], res[1], 1, rx_ns),
+                                 [False], rx_ns)
                             )
                             continue
                         # Defer dispatch to the 1ms pump (or the next
@@ -276,7 +294,10 @@ class Connection:
                         # body below flushes the deferred run first.
                         run = self._fast_run
                         if run is None:
-                            self._fast_run = [res[0], res[1], 1]
+                            # The run keeps its OLDEST read's stamp: a
+                            # held batch's delivery latency is the age
+                            # of its most-delayed message, honestly.
+                            self._fast_run = [res[0], res[1], 1, rx_ns]
                             _pending_ingest.add(self)
                         else:
                             run[0].extend(res[0])
@@ -300,13 +321,15 @@ class Connection:
                         # Order must hold: once anything is stashed, every
                         # later message queues behind it.
                         pending_msgs.extend(
-                            (m, drop_token) for m in packet.messages[i:]
+                            (m, drop_token, rx_ns)
+                            for m in packet.messages[i:]
                         )
                         break
                     result = receive_message(mp)
                     if result is None:  # target queue full: stash, not drop
                         pending_msgs.extend(
-                            (m, drop_token) for m in packet.messages[i:]
+                            (m, drop_token, rx_ns)
+                            for m in packet.messages[i:]
                         )
                         break
                     if not result and not drop_token[0]:
@@ -341,10 +364,10 @@ class Connection:
     def _dispatch_forward_run(self, run: list) -> None:
         """Hand one accumulated fast-path run to the channel queue,
         with the same stash/drop accounting as per-message dispatch."""
-        batch = _ForwardBatch(run[0], run[1], run[2])
+        batch = _ForwardBatch(run[0], run[1], run[2], run[3])
         result = self.receive_message(batch)
         if result is None:  # queue full: stash for flush_pending
-            self._pending_msgs.append((batch, [False]))
+            self._pending_msgs.append((batch, [False], run[3]))
         elif result is False:
             # The whole run failed (no target channel): one drop per
             # originating packet, like the per-message path.
@@ -359,7 +382,7 @@ class Connection:
         Forward batches always target GLOBAL (0)."""
         if not self._pending_msgs:
             return None
-        mp, _ = self._pending_msgs[0]
+        mp = self._pending_msgs[0][0]
         return 0 if type(mp) is _ForwardBatch else mp.channelId
 
     def flush_pending(self) -> bool:
@@ -367,7 +390,11 @@ class Connection:
         Stops (False) at the first message whose channel queue is still
         full — call again after the next drain signal."""
         while self._pending_msgs:
-            mp, drop_token = self._pending_msgs[0]
+            mp, drop_token, stamp = self._pending_msgs[0]
+            # Re-dispatch under the message's ORIGINAL ingest stamp: a
+            # stash-held message's delivery latency must include the
+            # hold (never re-stamped smaller, never negative).
+            self._rx_stamp_ns = stamp
             result = self.receive_message(mp)
             if result is None:
                 self._publish_msg_received()
@@ -417,7 +444,8 @@ class Connection:
             channel = get_channel(0)
             if channel is None:
                 return False
-            if not channel.put_forward_batch(mp.entries, self):
+            if not channel.put_forward_batch(mp.entries, self,
+                                             ingest_ns=mp.ingest_ns):
                 return None  # queue full: caller stashes and retries
             pending = self._msg_received_pending
             ct = channel.channel_type
@@ -491,7 +519,8 @@ class Connection:
             handler = entry.handler
 
         if not channel.put_message(msg, handler, self, mp, raw_body=raw_body,
-                                   external=True):
+                                   external=True,
+                                   ingest_ns=self._rx_stamp_ns):
             return None  # queue full: caller stashes and retries (no drop)
         # FSM advance only after the enqueue succeeds: the queue-full
         # retry path re-enters this function with the same pack, and a
@@ -622,7 +651,7 @@ class Connection:
             if self._pending_msgs:
                 dropped = 0
                 counted = set()
-                for mp, drop_token in self._pending_msgs:
+                for mp, drop_token, _stamp in self._pending_msgs:
                     if drop_token[0] or id(drop_token) in counted:
                         continue
                     counted.add(id(drop_token))

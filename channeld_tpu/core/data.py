@@ -25,6 +25,7 @@ from ..utils.anyutil import pack_any, unpack_any
 from ..utils.fieldmask import filter_fields
 from ..utils.logger import get_logger
 from .overload import governor as _governor
+from .slo import slo as _slo
 from .types import ChannelDataAccess, ChannelType, MessageType
 
 if TYPE_CHECKING:
@@ -122,6 +123,10 @@ class UpdateBufferElement:
     arrival_time: int  # ns, channel time
     sender_conn_id: int
     message_index: int
+    # Host-monotonic connection-read stamp (0 = internal update): the
+    # delivery-SLO plane measures ingest->fan-out against this
+    # (core/slo.py record_delivery).
+    ingest_ns: int = 0
 
 
 @dataclass
@@ -176,9 +181,12 @@ class ChannelData:
         sender_conn_id: int,
         spatial_notifier=None,
         now_ns: Optional[int] = None,
+        ingest_ns: int = 0,
     ) -> None:
         """(ref: data.go:149-173). ``now_ns`` optionally bounds stray
-        arrival stamps to the channel's own clock."""
+        arrival stamps to the channel's own clock; ``ingest_ns`` is the
+        connection-read host stamp the delivery-SLO plane threads to
+        the fan-out (0 = internal)."""
         if self.msg is None:
             # Adoption (channeld-tpu extension; the reference drops updates
             # until data is initialized): only write-access subscribers
@@ -220,7 +228,8 @@ class ChannelData:
             arrival_time = min(arrival_time, now_ns)
         arrival_time = max(arrival_time, tail)
         self.update_msg_buffer.append(
-            UpdateBufferElement(update_msg, arrival_time, sender_conn_id, self.msg_index)
+            UpdateBufferElement(update_msg, arrival_time, sender_conn_id,
+                                self.msg_index, ingest_ns)
         )
         if len(self.update_msg_buffer) > MAX_UPDATE_MSG_BUFFER_SIZE:
             oldest = self.update_msg_buffer[0]
@@ -255,6 +264,26 @@ def _accumulate_window(data: "ChannelData", window: list, fresh: bool = False):
     for be in window[1:]:
         merge_with_options(acc, be.update_msg, data.merge_options, None)
     return acc
+
+
+def _newest_ingest_ns(window: list) -> int:
+    """The newest non-zero connection-read stamp in a delivered window
+    (0 when every update was internal). Windows are small (bounded by
+    the update ring); the scan usually exits on the last element."""
+    for be in reversed(window):
+        if be.ingest_ns:
+            return be.ingest_ns
+    return 0
+
+
+def _record_window_delivery(channel: "Channel", window: list,
+                            path: str) -> None:
+    """One delivery-latency sample for a just-sent fan-out window,
+    stamped with the NEWEST externally-ingested update it carries
+    (core/slo.py; the pipeline-transit reading of delivery latency)."""
+    ingest_ns = _newest_ingest_ns(window)
+    if ingest_ns:
+        _slo.record_delivery(channel.channel_type.name, path, ingest_ns)
 
 
 def _device_due_view(channel: "Channel"):
@@ -417,6 +446,7 @@ def tick_data(channel: "Channel", now: int) -> None:
                 entry = shared_windows[(lo, hi)] = [
                     {be.sender_conn_id for be in data.update_msg_buffer[lo:hi]},
                     None,
+                    False,  # delivery-SLO sample taken for this window
                 ]
             if cs.options.skipSelfUpdateFanOut and conn.id in entry[0]:
                 # This subscriber's own update is in the slice: accumulate
@@ -439,6 +469,13 @@ def tick_data(channel: "Channel", now: int) -> None:
                         fan_out_data_update(
                             channel, conn, cs, _accumulate_window(data, window)
                         )
+                    if _slo.enabled:
+                        _record_window_delivery(
+                            channel, window,
+                            "device" if device is not None
+                            and foc.device_sub_slot is not None
+                            else "host",
+                        )
             elif hi > lo:
                 # Shared path: merge the slice once, reuse for every
                 # subscriber with this exact window. The cached message
@@ -453,6 +490,17 @@ def tick_data(channel: "Channel", now: int) -> None:
                     )
                 foc.last_message_index = data.update_msg_buffer[hi - 1].message_index
                 fan_out_data_update(channel, conn, cs, entry[1], body_cache)
+                if _slo.enabled and not entry[2]:
+                    # ONE sample per distinct window per tick, however
+                    # many subscribers share it (bounded cost; the
+                    # first deliverer's path labels it).
+                    entry[2] = True
+                    _record_window_delivery(
+                        channel, data.update_msg_buffer[lo:hi],
+                        "device" if device is not None
+                        and foc.device_sub_slot is not None
+                        else "host",
+                    )
 
         foc.last_fanout_time = latest_fanout_time
 

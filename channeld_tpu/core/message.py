@@ -48,14 +48,16 @@ class MessageContext:
     it alone is a property."""
 
     __slots__ = ("msg_type", "_msg", "broadcast", "stub_id", "channel_id",
-                 "connection", "channel", "arrival_time", "raw_body")
+                 "connection", "channel", "arrival_time", "raw_body",
+                 "ingest_ns")
 
     def __init__(self, msg_type: int = 0, msg: Optional[Message] = None,
                  broadcast: int = 0, stub_id: int = 0, channel_id: int = 0,
                  connection: Optional[object] = None,
                  channel: Optional["Channel"] = None,
                  arrival_time: float = 0.0,
-                 raw_body: Optional[bytes] = None):
+                 raw_body: Optional[bytes] = None,
+                 ingest_ns: int = 0):
         self.msg_type = msg_type
         self._msg = msg
         self.broadcast = broadcast
@@ -64,6 +66,10 @@ class MessageContext:
         self.connection = connection  # receiving connection
         self.channel = channel
         self.arrival_time = arrival_time
+        # Host-monotonic stamp of the connection read that carried this
+        # message (0 = internal); rides into the update ring so the
+        # fan-out can record end-to-end delivery latency (core/slo.py).
+        self.ingest_ns = ingest_ns
         # Pre-serialized ``msg`` bytes: senders use these instead of
         # re-serializing, letting a broadcast share one encode across all
         # recipients. Reassigning ``msg`` invalidates them (see setter).
@@ -98,6 +104,7 @@ class MessageContext:
             channel_id=self.channel_id,
             connection=self.connection,
             channel=self.channel,
+            ingest_ns=self.ingest_ns,
         )
 
 
@@ -635,7 +642,7 @@ def handle_channel_data_update(ctx: MessageContext) -> None:
             ch.set_data_update_conn_id(msg.contextConnId)
     ch.data.on_update(
         update_msg, ctx.arrival_time, ctx.connection.id, ch.spatial_notifier,
-        now_ns=ch.get_time(),
+        now_ns=ch.get_time(), ingest_ns=ctx.ingest_ns,
     )
 
 

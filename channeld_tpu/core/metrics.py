@@ -431,6 +431,58 @@ follower_interest_ms = Histogram(
     registry=registry,
 )
 
+# Fleet health plane: end-to-end delivery SLOs (core/slo.py;
+# doc/observability.md). The bucket edges are shared with the SLO
+# plane's python-side tally (slo.delivery_quantile — the soak's <5ms
+# verdict cross-check), so they live in ONE tuple.
+DELIVERY_LATENCY_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 5.0, 10.0,
+                            33.0, 100.0, 1000.0)
+delivery_latency_ms = Histogram(
+    "delivery_latency_ms",
+    "End-to-end ingest->fan-out delivery latency, milliseconds: the "
+    "monotonic ingest stamp placed on a forwarded update at the "
+    "connection read (fast and slow paths) measured against the send "
+    "of the fan-out that delivers it. One sample per delivered fan-out "
+    "window, stamped with the NEWEST update the window carries — the "
+    "gateway-pipeline transit the < 5ms north-star claim is about; "
+    "cadence-held staleness is fanout_staleness_ms. path=fast: the "
+    "batched native-ingest forward to the GLOBAL owner; path=host / "
+    "path=device: the host-scan and device-due ChannelData fan-outs",
+    ["channel_type", "path"],
+    buckets=DELIVERY_LATENCY_BUCKETS,
+    registry=registry,
+)
+fanout_staleness_ms = Histogram(
+    "fanout_staleness_ms",
+    "Age of the newest merged-but-undelivered channel state per "
+    "subscriber class, milliseconds (sub_class: p0 WRITE/authority, "
+    "p1 default-cadence READ, p2 background observers — the overload "
+    "ladder's shed order). Sampled once per GLOBAL tick for one "
+    "round-robin channel with live data (bounded cost; core/slo.py)",
+    ["channel_type", "sub_class"],
+    buckets=(1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 1000.0, 5000.0),
+    registry=registry,
+)
+slo_burn_rate = Gauge(
+    "slo_burn_rate",
+    "Multi-window SLO error-budget burn rate (1.0 == consuming the "
+    "budget exactly as fast as the objective allows; core/slo.py "
+    "evaluates each declared SLO's bad-event fraction over every "
+    "configured window each GLOBAL tick)",
+    ["slo", "window"],
+    registry=registry,
+)
+slo_breaches = Counter(
+    "slo_breaches",
+    "SLO burn-rate alarm firings by SLO (a window's burn rate crossed "
+    "its alarm threshold — counted once per rising edge per window, "
+    "and each breach freezes a flight-recorder slo_breach anomaly "
+    "dump so the violating tick timeline ships with the alarm). The "
+    "python ledger in core/slo.py (breach_counts) must match exactly",
+    ["slo"],
+    registry=registry,
+)
+
 # Flight recorder / tick-timeline tracing (core/tracing.py;
 # doc/observability.md).
 tick_stage_ms = Histogram(
@@ -456,7 +508,8 @@ trace_dumps = Counter(
     "migration_abort: a balancer cell migration rolled back; "
     "failover_epoch: a dead server's cells were re-hosted; "
     "device_failure: the device engine failed fatally and is "
-    "rebuilding in-process; "
+    "rebuilding in-process; slo_breach: an SLO burn-rate alarm fired "
+    "(core/slo.py); "
     "manual/sigusr2/shutdown: explicit dump_trace calls). Anomaly "
     "triggers count even when the dump itself was suppressed by the "
     "cooldown; a disabled recorder (-trace false) counts nothing",

@@ -671,14 +671,31 @@ async def run_server(argv: Optional[list[str]] = None) -> None:
             global_settings.federation_config,
         )
 
-    from .metrics import serve_metrics
+    # Delivery-SLO plane (doc/observability.md): ingest->fan-out
+    # latency stamping, burn-rate tracking, breach anomaly dumps, and
+    # (federated) the fleet metric digests on the control epoch.
+    from . import slo as slo_mod
+
+    slo_mod.configure_from_settings()
+    if global_settings.slo_enabled:
+        logger.info(
+            "SLO plane armed: %s (burn-rate windows per SLO; breaches "
+            "freeze a flight-recorder dump; doc/observability.md)",
+            ", ".join(sorted(slo_mod.slo.status())),
+        )
+
+    # The ops surface replaces the bare metrics listener: /metrics is
+    # one of its routes (scrape configs unchanged), /healthz + /readyz
+    # feed the k8s/compose probes, /introspect + /fleet feed operators
+    # and scripts/fleetctl.py (doc/observability.md).
+    from .opshttp import serve_ops
 
     if global_settings.metrics_port:
         try:
-            serve_metrics(global_settings.metrics_port)
+            serve_ops(global_settings.metrics_port)
         except OSError:
-            logger.warning("metrics port %d unavailable; /metrics disabled",
-                           global_settings.metrics_port)
+            logger.warning("metrics port %d unavailable; ops surface "
+                           "disabled", global_settings.metrics_port)
 
     # Durable-state boot BEFORE the trunks/listeners come up: restore
     # the snapshot and replay the WAL tail (doc/persistence.md) so the

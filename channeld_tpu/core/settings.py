@@ -317,6 +317,22 @@ class GlobalSettings:
     trace_dump_ticks: int = 200  # ticks frozen into an anomaly dump
     trace_anomaly_cooldown_s: float = 5.0
 
+    # Fleet health plane (new — doc/observability.md). With the SLO
+    # plane armed, forwarded updates carry a monotonic ingest stamp to
+    # the fan-out send (delivery_latency_ms — the live measurement
+    # behind the < 5ms p99 claim), a declarative SLO table (delivery
+    # p99, tick budget, trunk RTT, WAL fsync RPO) is evaluated
+    # in-process with multi-window burn rates every GLOBAL tick, each
+    # breach freezes a flight-recorder slo_breach anomaly dump, and
+    # federated gateways attach a metric digest to the control-epoch
+    # load report so any gateway's /fleet endpoint shows the whole
+    # fleet in one scrape. Soaks with deterministic envelopes pin the
+    # plane off (their accounting predates the extra samples).
+    slo_enabled: bool = True
+    # Operator SLO table (JSON list of core/slo.py SloSpec rows);
+    # empty = the built-in defaults.
+    slo_config: str = ""
+
     # Device mesh for the spatial engine: 0 devices = single-device step;
     # N>0 shards the entity arrays over the first N jax devices, and
     # hosts>1 arranges them as a (hosts, chips) DCN x ICI mesh — the TPU
@@ -528,6 +544,18 @@ class GlobalSettings:
         p.add_argument("-trace-dump-ticks", type=int,
                        default=self.trace_dump_ticks,
                        help="GLOBAL ticks frozen into an anomaly dump")
+        p.add_argument("-slo",
+                       type=lambda s: s.lower() not in
+                       ("false", "0", "no", "off"),
+                       default=self.slo_enabled,
+                       help="delivery-SLO plane: ingest->fan-out "
+                            "latency stamping, burn-rate tracking, "
+                            "breach anomaly dumps, fleet metric "
+                            "digests (doc/observability.md); false "
+                            "disarms every hook")
+        p.add_argument("-slo-config", type=str, default=self.slo_config,
+                       help="JSON SLO table overriding the built-in "
+                            "defaults (core/slo.py SloSpec rows)")
         p.add_argument("-mesh-devices", type=int, default=self.tpu_mesh_devices,
                        help="shard the spatial engine over N devices "
                             "(0 = single-device step)")
@@ -597,6 +625,8 @@ class GlobalSettings:
         self.trace_enabled = args.trace
         self.trace_ring_spans = args.trace_ring
         self.trace_dump_ticks = args.trace_dump_ticks
+        self.slo_enabled = args.slo
+        self.slo_config = args.slo_config
         self.spatial_backend = args.spatial_backend
         self.tpu_mesh_devices = args.mesh_devices
         self.tpu_mesh_hosts = args.mesh_hosts

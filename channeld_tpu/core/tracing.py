@@ -316,15 +316,21 @@ class FlightRecorder:
                     trigger, len(doc["traceEvents"]), path)
         return path
 
-    def note_anomaly(self, trigger: str, detail: str = "") -> Optional[str]:
+    def note_anomaly(self, trigger: str, detail: str = "",
+                     force: bool = False) -> Optional[str]:
         """An anomalous tick: count it, and (cooldown permitting) freeze
         the ring and auto-dump the last ``dump_ticks`` ticks. Returns
         the dump path when one was written. A disabled recorder is a
         full no-op — call sites guard on ``recorder.enabled`` and this
         matches them: ``-trace false`` means no anomaly accounting at
-        all, not a metric without dumps. The snapshot is synchronous
-        (a bounded ring copy); the JSON write runs on a daemon thread so
-        the tick that tripped the anomaly is not stalled by disk I/O."""
+        all, not a metric without dumps. ``force`` skips the cooldown
+        CHECK (the window still resets): for triggers that are rare by
+        construction AND must always ship a timeline — an SLO breach
+        (core/slo.py: rising-edge + min-events gated) would otherwise
+        lose its dump slot to a storm of per-tick tick_budget anomalies
+        on a saturated box. The snapshot is synchronous (a bounded ring
+        copy); the JSON write runs on a daemon thread so the tick that
+        tripped the anomaly is not stalled by disk I/O."""
         if not self.enabled:
             return None
         from . import metrics
@@ -335,7 +341,7 @@ class FlightRecorder:
         self.anomalies.append(record)
         del self.anomalies[:-256]  # bounded like everything else here
         now = time.monotonic()
-        if now - self._last_dump_at < self.anomaly_cooldown_s:
+        if not force and now - self._last_dump_at < self.anomaly_cooldown_s:
             return None
         self._last_dump_at = now
         # Only the ring freeze (a bounded copy) runs on the tick path;

@@ -238,6 +238,13 @@ class WriteAheadLog:
     def current_seq(self) -> int:
         return self._seq
 
+    def writer_alive(self) -> bool:
+        """Readiness probe surface (core/opshttp.py /readyz): the
+        journal is armed AND its writer thread is live and not wedged —
+        anything else means appends are no longer becoming durable."""
+        return (self.enabled and not self._wedged
+                and self._thread is not None and self._thread.is_alive())
+
     def append(self, kind: str, rec) -> int:
         """Assign a sequence number, enqueue for the writer, count. The
         ONLY I/O here is a list append under a lock — the framing,
@@ -432,9 +439,15 @@ class WriteAheadLog:
                 f.flush()
                 os.fsync(f.fileno())
                 self._flushed_seq = max(self._flushed_seq, top_seq)
-                metrics.wal_fsync_ms.observe(
-                    (time.monotonic() - t0) * 1000.0
-                )
+                fsync_ms = (time.monotonic() - t0) * 1000.0
+                metrics.wal_fsync_ms.observe(fsync_ms)
+                from .slo import slo as _slo
+
+                if _slo.enabled:
+                    # wal_fsync_rpo SLO event (core/slo.py; the ring
+                    # intake is thread-safe — this is the writer
+                    # thread, not the tick path).
+                    _slo.observe("wal_fsync", fsync_ms)
         except Exception:
             # The journal can no longer make anything durable: disarm so
             # the hooks stop queueing (unbounded memory otherwise) and

@@ -559,6 +559,15 @@ class GlobalControlPlane:
             ],
             directoryVersion=vector["directory_version"],
         )
+        from ..core.slo import slo as _slo
+
+        if _slo.enabled:
+            # Fleet metric federation (federation/obs.py): the digest
+            # rides the load report — no extra trunk traffic, and any
+            # gateway's /fleet shows every peer one epoch later.
+            from .obs import fleet
+
+            fleet.attach_digest(msg)
         for peer in self.live_peers():
             link = self.plane.link_to(peer)
             if link is not None:
@@ -2372,6 +2381,10 @@ class GlobalControlPlane:
                 "blocks": dict(zip(msg.blockIndices, msg.blockEntities)),
                 "directory_version": msg.directoryVersion,
             }
+            if msg.metricsJson:
+                from .obs import fleet
+
+                fleet.store_peer(msg.gatewayId or peer, msg.metricsJson)
         elif msg_type == MessageType.TRUNK_SHARD_EPOCH:
             self._on_shard_epoch(peer, msg)
         elif msg_type == MessageType.TRUNK_SHARD_MIGRATE:

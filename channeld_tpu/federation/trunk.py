@@ -233,6 +233,11 @@ class TrunkLink:
             rtt_ms = time.monotonic() * 1000.0 - msg.sentAtMs
             if 0 <= rtt_ms < 60_000:
                 metrics.trunk_rtt_ms.observe(rtt_ms)
+                from ..core.slo import slo as _slo
+
+                if _slo.enabled:
+                    # The trunk_rtt SLO's event stream (core/slo.py).
+                    _slo.observe("trunk_rtt", rtt_ms)
                 self.rtt_ms = (
                     rtt_ms if self.rtt_ms == 0.0
                     else 0.25 * rtt_ms + 0.75 * self.rtt_ms

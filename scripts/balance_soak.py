@@ -193,6 +193,10 @@ async def run_balance_soak(p: BalanceSoakParams) -> dict:
     # any chaos-adjacent retry would perturb it. The device plane's
     # own soak is scripts/device_soak.py.
     global_settings.device_guard_enabled = False
+    # SLO plane pinned OFF (doc/observability.md): this soak's
+    # envelope predates the delivery-latency sampling; the health
+    # plane has its own soak (scripts/obs_soak.py).
+    global_settings.slo_enabled = False
     from channeld_tpu.core.tracing import recorder as _flight_recorder
 
     _flight_recorder.configure(enabled=False)

@@ -193,6 +193,10 @@ async def run_soak(p: SoakParams) -> dict:
     # off to keep the envelope deterministic, like every other soak.
     global_settings.balancer_enabled = False
     global_settings.trace_enabled = False
+    # SLO plane pinned OFF (doc/observability.md): this soak's
+    # envelope predates the delivery-latency sampling; the health
+    # plane has its own soak (scripts/obs_soak.py).
+    global_settings.slo_enabled = False
     from channeld_tpu.core.tracing import recorder as _flight_recorder
 
     _flight_recorder.configure(enabled=False)

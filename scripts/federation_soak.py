@@ -265,6 +265,10 @@ async def boot_gateway(gw_id: str, fed_cfg: dict, params: FedSoakParams,
     # any chaos-adjacent retry would perturb it. The device plane's
     # own soak is scripts/device_soak.py.
     global_settings.device_guard_enabled = False
+    # SLO plane pinned OFF (doc/observability.md): this soak's
+    # envelope predates the delivery-latency sampling; the health
+    # plane has its own soak (scripts/obs_soak.py).
+    global_settings.slo_enabled = False
     # Global control plane pinned OFF (doc/global_control.md): its
     # leader-planned shard migrations and death declarations would add
     # nondeterministic authority moves to this soak's envelope

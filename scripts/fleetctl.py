@@ -1,0 +1,186 @@
+"""fleetctl: query the live ops surface across a gateway fleet.
+
+Every gateway serves /healthz /readyz /introspect /fleet on its
+metrics port (core/opshttp.py; doc/observability.md). This tool fans
+one of those queries out over the fleet and renders a per-gateway
+table, so an operator answers "is the fleet healthy / who is leader /
+where are the entities" without a Prometheus stack.
+
+Targets come from either:
+
+- ``--fed config.json`` — the federation config every gateway already
+  shares (targets derive from each gateway's ``client`` host +
+  ``--mport``; override per-gateway with ``"metrics": "host:port"``
+  entries), or
+- ``--targets host:port[,host:port...]`` — explicit.
+
+Usage:
+  python scripts/fleetctl.py --targets 127.0.0.1:8080 status
+  python scripts/fleetctl.py --fed deploy/fed.json ready
+  python scripts/fleetctl.py --fed deploy/fed.json introspect
+  python scripts/fleetctl.py --targets 127.0.0.1:8080 fleet
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.error
+import urllib.request
+
+
+def _fetch(target: str, path: str, timeout: float) -> tuple[int, object]:
+    """(status, parsed JSON or text); status 0 = unreachable."""
+    url = f"http://{target}{path}"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            body = resp.read()
+            code = resp.status
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        code = e.code
+    except (urllib.error.URLError, OSError, TimeoutError) as e:
+        return 0, f"unreachable: {e}"
+    try:
+        return code, json.loads(body)
+    except ValueError:
+        return code, body.decode(errors="replace")
+
+
+def targets_from_fed(path: str, mport: int) -> dict[str, str]:
+    """{gateway id: host:port} from the shared federation config."""
+    with open(path) as f:
+        cfg = json.load(f)
+    out: dict[str, str] = {}
+    for gw_id, g in sorted(cfg.get("gateways", {}).items()):
+        if g.get("metrics"):
+            out[gw_id] = g["metrics"]
+            continue
+        client = g.get("client", "")
+        host = client.rpartition(":")[0] or "127.0.0.1"
+        out[gw_id] = f"{host}:{mport}"
+    return out
+
+
+def _row(cols: list[str], widths: list[int]) -> str:
+    return "  ".join(c.ljust(w) for c, w in zip(cols, widths)).rstrip()
+
+
+def cmd_status(targets: dict[str, str], timeout: float) -> int:
+    rows = []
+    worst = 0
+    for name, target in targets.items():
+        code, doc = _fetch(target, "/introspect", timeout)
+        if code != 200 or not isinstance(doc, dict):
+            rows.append([name, target, "DOWN", "-", "-", "-", "-",
+                         str(doc)[:48]])
+            worst = max(worst, 2)
+            continue
+        ready = doc.get("ready", False)
+        if not ready:
+            worst = max(worst, 1)
+        conns = doc.get("connections", {})
+        rows.append([
+            name, target,
+            "ready" if ready else "NOT-READY",
+            str(sum(v for v in conns.values()
+                    if isinstance(v, int))),
+            str(doc.get("entities", "-")),
+            f"L{doc.get('overload', {}).get('level', '?')}",
+            doc.get("device", "?"),
+            f"tick {doc.get('tick', 0)}",
+        ])
+    header = ["gateway", "target", "state", "conns", "entities",
+              "overload", "device", "note"]
+    widths = [max(len(header[i]), *(len(r[i]) for r in rows))
+              if rows else len(header[i]) for i in range(len(header))]
+    print(_row(header, widths))
+    for r in rows:
+        print(_row(r, widths))
+    return worst
+
+
+def cmd_ready(targets: dict[str, str], timeout: float) -> int:
+    worst = 0
+    for name, target in targets.items():
+        code, doc = _fetch(target, "/readyz", timeout)
+        if code == 200:
+            print(f"{name} ({target}): ready")
+            continue
+        worst = max(worst, 1 if code == 503 else 2)
+        print(f"{name} ({target}): NOT READY (http {code})")
+        if isinstance(doc, dict):
+            for comp, st in doc.get("components", {}).items():
+                if not st.get("ok", True):
+                    print(f"  - {comp}: {st.get('detail', '')}")
+        else:
+            print(f"  - {doc}")
+    return worst
+
+
+def cmd_introspect(targets: dict[str, str], timeout: float) -> int:
+    out = {}
+    rc = 0
+    for name, target in targets.items():
+        code, doc = _fetch(target, "/introspect", timeout)
+        out[name] = doc if code == 200 else {"error": doc, "http": code}
+        if code != 200:
+            rc = 2
+    print(json.dumps(out, indent=2))
+    return rc
+
+
+def cmd_fleet(targets: dict[str, str], timeout: float,
+              as_json: bool) -> int:
+    # Any gateway answers for the fleet; take the first reachable one.
+    for name, target in targets.items():
+        path = "/fleet?format=json" if as_json else "/fleet"
+        code, doc = _fetch(target, path, timeout)
+        if code == 200:
+            if as_json:
+                print(json.dumps(doc, indent=2))
+            else:
+                print(doc if isinstance(doc, str) else json.dumps(doc))
+            return 0
+        print(f"# {name} ({target}) unavailable: {doc}", file=sys.stderr)
+    print("no reachable gateway", file=sys.stderr)
+    return 2
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--fed", default="",
+                    help="federation config JSON (targets derive from "
+                         "each gateway's client host + --mport)")
+    ap.add_argument("--targets", default="",
+                    help="explicit host:port[,host:port...] targets")
+    ap.add_argument("--mport", type=int, default=8080,
+                    help="metrics/ops port used with --fed targets")
+    ap.add_argument("--timeout", type=float, default=3.0)
+    ap.add_argument("--json", action="store_true",
+                    help="fleet: render the JSON census form")
+    ap.add_argument("command", choices=("status", "ready", "introspect",
+                                        "fleet"))
+    args = ap.parse_args()
+
+    targets: dict[str, str] = {}
+    if args.fed:
+        targets.update(targets_from_fed(args.fed, args.mport))
+    if args.targets:
+        for i, t in enumerate(x for x in args.targets.split(",") if x):
+            targets[f"t{i}" if args.fed else t] = t
+    if not targets:
+        ap.error("no targets: pass --fed or --targets")
+
+    if args.command == "status":
+        return cmd_status(targets, args.timeout)
+    if args.command == "ready":
+        return cmd_ready(targets, args.timeout)
+    if args.command == "introspect":
+        return cmd_introspect(targets, args.timeout)
+    return cmd_fleet(targets, args.timeout, args.json)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

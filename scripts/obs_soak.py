@@ -1,0 +1,1040 @@
+"""Obs soak: the fleet health plane's acceptance proof (OBS_r15.json).
+
+Three phases exercise the delivery-SLO plane (core/slo.py), the ops
+surface (core/opshttp.py) and the fleet metric federation
+(federation/obs.py) the way they run in production:
+
+1. **live** — a REAL single gateway (TCP listeners, 1ms pump, the TPU
+   cells controller, a forward-streaming client fleet plus an
+   updater/viewer channel whose CHANNEL_DATA_UPDATEs arrive over real
+   sockets), SLO plane ON, ops surface on an ephemeral port. A steady
+   window measures live-gateway ``delivery_latency_ms`` p99 under load
+   (the < 5ms verdict recorded honestly, pass or fail); then a seeded
+   chaos scenario stalls message handling to inject a latency breach —
+   the burn-rate alarm must fire (``slo_breaches_total`` == python
+   ledger) and freeze a Perfetto-valid ``slo_breach`` anomaly dump.
+   ``/healthz`` stays 200 throughout; ``/readyz`` flips 200 -> 503 ->
+   200 across a device-guard FAILED fault (state driven directly; the
+   guard *reaching* FAILED under real faults is SOAK_DEVICE_r13's
+   proof) and across a WAL-writer death.
+2. **federation** — two gateway processes with the SLO plane + global
+   control re-armed: metric digests ride the control-epoch load
+   reports, and after traffic quiesces the fleet view must be EXACT —
+   gateway b's self-reported digest equals the copy stored on a, and
+   every family/labelset in a's rendered ``/fleet`` equals the
+   element-wise sum of the two per-gateway digests.
+3. **overhead** — the synchronous GLOBAL-tick hot path (device step +
+   stamped updates + subscribed fan-out) timed with the SLO plane
+   enabled vs disabled, per-tick-alternated, medians: the acceptance
+   bar is < 2% overhead with SLO tracking enabled.
+
+Run the acceptance soak (~60s of timeline):
+  python scripts/obs_soak.py --out OBS_r15.json
+
+The <60s CI smoke runs phases 1 and 3 with smaller numbers
+(tests/test_slo.py::test_obs_soak_smoke).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+# chaos_soak pins the CPU platform + virtual devices BEFORE jax loads.
+import chaos_soak as live  # noqa: E402
+import federation_soak as fed  # noqa: E402
+
+import argparse  # noqa: E402
+import asyncio  # noqa: E402
+import json  # noqa: E402
+import re  # noqa: E402
+import statistics  # noqa: E402
+import subprocess  # noqa: E402
+import time  # noqa: E402
+import urllib.request  # noqa: E402
+from dataclasses import dataclass, field  # noqa: E402
+from random import Random  # noqa: E402
+
+DEFAULT_SCENARIO = {
+    "name": "obs-soak",
+    "seed": 20260804,
+    "faults": [
+        # 60ms stalls in message handling: every fan-out that tick is
+        # delivered late -> delivery/tick_budget SLO burn -> breach.
+        {"point": "channel.tick_budget", "every_n": 25,
+         "stall_ms": 60, "max_fires": 60},
+    ],
+}
+
+
+@dataclass
+class ObsSoakParams:
+    steady_s: float = 15.0
+    breach_s: float = 12.0
+    clients: int = 8
+    msg_rate: float = 20.0
+    viewers: int = 4
+    update_rate: float = 40.0
+    entities: int = 48
+    warmup_s: float = 6.0
+    quiesce_s: float = 2.0
+    fed_run_s: float = 8.0
+    fed_epoch_ms: int = 200
+    overhead_ticks: int = 120
+    overhead_rounds: int = 3
+    seed: int = 20260804
+    scenario: dict = field(default_factory=lambda: dict(DEFAULT_SCENARIO))
+    skip_federation: bool = False
+    out_path: str = ""
+
+
+def _http(port: int, path: str, timeout: float = 3.0):
+    """(status, parsed-JSON-or-text) from the local ops surface."""
+    import urllib.error
+
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout
+        ) as resp:
+            body, code = resp.read(), resp.status
+    except urllib.error.HTTPError as e:
+        body, code = e.read(), e.code
+    try:
+        return code, json.loads(body)
+    except ValueError:
+        return code, body.decode(errors="replace")
+
+
+_EXPO_RE = re.compile(
+    r"^([a-zA-Z_][a-zA-Z0-9_]*)(\{[^}]*\})?\s+([0-9.eE+-]+|NaN|[+-]Inf)$"
+)
+
+
+def parse_exposition(text: str) -> dict:
+    """{(name, labels-string): float} for every sample line."""
+    out = {}
+    for line in text.splitlines():
+        if line.startswith("#") or not line.strip():
+            continue
+        m = _EXPO_RE.match(line.strip())
+        if m:
+            out[(m.group(1), m.group(2) or "")] = float(m.group(3))
+    return out
+
+
+def _check_perfetto(path: str) -> tuple[bool, str]:
+    """Same pinned schema as trace_soak (dumps land off-thread)."""
+    doc = None
+    deadline = time.monotonic() + 3.0
+    while doc is None:
+        try:
+            doc = json.load(open(path))
+        except (OSError, ValueError) as e:
+            if time.monotonic() > deadline:
+                return False, f"unreadable: {e}"
+            time.sleep(0.05)
+    try:
+        assert set(doc) >= {"traceEvents", "displayTimeUnit", "otherData"}
+        for ev in doc["traceEvents"]:
+            assert set(ev) >= {"name", "ph", "ts", "pid", "tid", "args"}
+            assert ev["ph"] in ("X", "i")
+    except AssertionError as e:
+        return False, f"schema violation: {e}"
+    return True, f"{len(doc['traceEvents'])} events"
+
+
+def _delivery_stats(delta: dict) -> dict:
+    """Per-(channel_type, path) delivery latency stats from a scrape
+    delta."""
+    from channeld_tpu.chaos.invariants import histogram_quantile
+
+    series: dict[tuple, dict] = {}
+    for (name, labels), value in delta.items():
+        ld = dict(labels)
+        if name == "delivery_latency_ms_count" and value > 0:
+            key = (ld["channel_type"], ld["path"])
+            series.setdefault(key, {})["count"] = int(value)
+        elif name == "delivery_latency_ms_sum" and "path" in ld:
+            key = (ld["channel_type"], ld["path"])
+            series.setdefault(key, {})["sum_ms"] = value
+    out = {}
+    for (ct, path), entry in sorted(series.items()):
+        if not entry.get("count"):
+            continue
+        out[f"{ct}/{path}"] = {
+            "count": entry["count"],
+            "mean_ms": round(entry.get("sum_ms", 0.0) / entry["count"], 4),
+            "p50_ms": round(histogram_quantile(
+                delta, "delivery_latency_ms", 0.50,
+                channel_type=ct, path=path) or 0.0, 4),
+            "p99_ms": round(histogram_quantile(
+                delta, "delivery_latency_ms", 0.99,
+                channel_type=ct, path=path) or 0.0, 4),
+        }
+    return out
+
+
+# ---------------------------------------------------------------------------
+# phase 1: live gateway — delivery p99, breach, ops surface
+# ---------------------------------------------------------------------------
+
+
+async def run_live_phase(p: ObsSoakParams, dump_dir: str) -> dict:
+    from channeld_tpu.chaos import arm, chaos, disarm
+    from channeld_tpu.chaos.invariants import delta, sample_total, scrape
+    from channeld_tpu.core import channel as channel_mod
+    from channeld_tpu.core import connection as connection_mod
+    from channeld_tpu.core import data as data_mod
+    from channeld_tpu.core import ddos as ddos_mod
+    from channeld_tpu.core import connection_recovery as recovery_mod
+    from channeld_tpu.core import opshttp
+    from channeld_tpu.core.channel import create_channel, init_channels
+    from channeld_tpu.core.connection import all_connections, init_connections
+    from channeld_tpu.core.ddos import init_anti_ddos, unauth_reaper_loop
+    from channeld_tpu.core.device_guard import DeviceState, guard
+    from channeld_tpu.core.overload import reset_overload
+    from channeld_tpu.core.server import flush_loop, start_listening
+    from channeld_tpu.core.settings import (
+        ChannelSettings,
+        global_settings,
+        reset_global_settings,
+    )
+    from channeld_tpu.core.slo import slo
+    from channeld_tpu.core.tracing import recorder
+    from channeld_tpu.core.types import (
+        ChannelDataAccess,
+        ChannelType,
+        ConnectionType,
+    )
+    from channeld_tpu.core.wal import wal
+    from channeld_tpu.federation import reset_federation
+    from channeld_tpu.models.sim import register_sim_types, sim_pb2
+    from channeld_tpu.protocol import control_pb2
+    from channeld_tpu.spatial.controller import (
+        get_spatial_controller,
+        init_spatial_controller,
+        reset_spatial_controller,
+    )
+    from channeld_tpu.utils.anyutil import pack_any
+
+    channel_mod.reset_channels()
+    connection_mod.reset_connections()
+    data_mod.reset_registries()
+    ddos_mod.reset_ddos()
+    recovery_mod.reset_recovery()
+    reset_spatial_controller()
+    reset_global_settings()
+    reset_overload()
+    reset_federation()
+
+    global_settings.development = True
+    global_settings.balancer_enabled = False
+    # The guard is enabled so /readyz reads a real DeviceState, but no
+    # device faults are injected here — the state is driven directly
+    # for the flip check (the guard REACHING these states under real
+    # faults is scripts/device_soak.py's proof, SOAK_DEVICE_r13).
+    global_settings.device_guard_enabled = True
+    global_settings.federation_config = ""
+    # Ladder pinned L0 like the trace soak: boot-compile stalls on a
+    # loaded CPU box would climb to L3 and refuse the client fleet.
+    global_settings.overload_enabled = False
+    global_settings.tpu_entity_capacity = 256
+    global_settings.tpu_query_capacity = 32
+    global_settings.channel_settings = {
+        ChannelType.GLOBAL: ChannelSettings(
+            tick_interval_ms=33, default_fanout_interval_ms=50),
+        ChannelType.SPATIAL: ChannelSettings(
+            tick_interval_ms=50, default_fanout_interval_ms=100),
+        ChannelType.ENTITY: ChannelSettings(
+            tick_interval_ms=50, default_fanout_interval_ms=100),
+        # The measured delivery channel. The fan-out interval must stay
+        # ABOVE the channel's achievable tick cadence on a loaded box:
+        # the reference's (last, last+interval] window advances one
+        # interval per due tick, so an interval shorter than the real
+        # tick period makes the window fall cumulatively behind real
+        # time and the "delivery latency" becomes accumulated window
+        # lag, not pipeline transit. 20ms tick / 50ms interval keeps
+        # the window current under this soak's load.
+        ChannelType.SUBWORLD: ChannelSettings(
+            tick_interval_ms=20, default_fanout_interval_ms=50),
+    }
+    # Subjects under test: SLO plane + anomaly dumps ON.
+    global_settings.trace_enabled = True
+    global_settings.slo_enabled = True
+    recorder.configure(
+        enabled=True, ring_spans=16384, dump_ticks=150,
+        dump_path=dump_dir, anomaly_cooldown_s=2.0, origin="obs-live",
+    )
+    slo.configure(enabled=True)
+
+    register_sim_types()
+    init_connections(
+        os.path.join(REPO, "config", "server_authoritative_fsm.json"),
+        os.path.join(REPO, "config", "client_authoritative_fsm.json"),
+    )
+    init_channels()
+    init_anti_ddos()
+    init_spatial_controller(
+        os.path.join(REPO, "config", "spatial_tpu_cells_2x2.json"))
+    ctl = get_spatial_controller()
+
+    ops = opshttp.serve_ops(0, host="127.0.0.1")
+    baseline = scrape()
+
+    host = "127.0.0.1"
+    server_srv = await start_listening(
+        ConnectionType.SERVER, "tcp", f"{host}:0")
+    server_port = server_srv.sockets[0].getsockname()[1]
+    client_srv = await start_listening(
+        ConnectionType.CLIENT, "tcp", f"{host}:0")
+    client_port = client_srv.sockets[0].getsockname()[1]
+
+    stop = asyncio.Event()
+    send_stop = asyncio.Event()
+    tasks = [
+        asyncio.ensure_future(flush_loop()),
+        asyncio.ensure_future(unauth_reaper_loop()),
+    ]
+    stats = live.SoakStats()
+    http_log: list[dict] = []
+    try:
+        (m_reader, m_writer, drain_task), spatial_socks = \
+            await live._boot_world(host, server_port, stats, stop)
+        tasks.append(drain_task)
+        tasks.extend(t for _, _, t in spatial_socks)
+
+        rng = Random(p.seed ^ 0x0b5)
+        sim_params = live.SoakParams(entities=p.entities, storm_size=20)
+        sim = live.EntitySim(ctl, sim_params, rng)
+        sim.create_entities()
+
+        for idx in range(p.clients):
+            tasks.append(asyncio.ensure_future(live._client_loop(
+                idx, host, client_port, p.msg_rate, stats, stop, send_stop,
+            )))
+
+        # -- the measured delivery channel: updater + viewers over REAL
+        # sockets. The updater's CHANNEL_DATA_UPDATE frames arrive via
+        # ordinary TCP ingest (the stamp point); viewer fan-outs leave
+        # via ordinary TCP sends. Subscription bookkeeping is done
+        # in-process for setup brevity.
+        from channeld_tpu.core.subscription import subscribe_to_channel
+
+        sub_ch = create_channel(ChannelType.SUBWORLD, None)
+        sub_ch.init_data(sim_pb2.SimSpatialChannelData(), None)
+
+        up_reader, up_writer = await live._connect(host, client_port)
+        await live._auth_and_wait(up_reader, up_writer, "obs-updater")
+        viewer_socks = []
+        for i in range(p.viewers):
+            r, w = await live._connect(host, client_port)
+            await live._auth_and_wait(r, w, f"obs-viewer-{i}")
+            viewer_socks.append((r, w))
+        await asyncio.sleep(0.3)  # server-side conns register
+
+        def _conn_of(pit: str):
+            for conn in all_connections().values():
+                if conn.pit == pit and not conn.is_closing():
+                    return conn
+            raise RuntimeError(f"no server-side conn for {pit}")
+
+        subscribe_to_channel(
+            _conn_of("obs-updater"), sub_ch,
+            control_pb2.ChannelSubscriptionOptions(
+                dataAccess=ChannelDataAccess.WRITE_ACCESS,
+                fanOutIntervalMs=1000, skipSelfUpdateFanOut=True))
+        for i in range(p.viewers):
+            subscribe_to_channel(
+                _conn_of(f"obs-viewer-{i}"), sub_ch,
+                control_pb2.ChannelSubscriptionOptions(
+                    dataAccess=ChannelDataAccess.READ_ACCESS,
+                    fanOutIntervalMs=50, skipSelfUpdateFanOut=False))
+
+        async def updater_loop():
+            eid = global_settings.entity_channel_id_start + 9001
+            seq = 0
+            interval = 1.0 / p.update_rate
+            while not stop.is_set() and not send_stop.is_set():
+                upd = sim_pb2.SimSpatialChannelData()
+                upd.entities[eid].entityId = eid
+                upd.entities[eid].transform.position.x = float(seq % 97)
+                body = control_pb2.ChannelDataUpdateMessage(
+                    data=pack_any(upd)).SerializeToString()
+                from channeld_tpu.core.types import MessageType
+
+                up_writer.write(live._frame(
+                    int(MessageType.CHANNEL_DATA_UPDATE), body,
+                    channel_id=sub_ch.id))
+                try:
+                    await up_writer.drain()
+                except (ConnectionError, OSError):
+                    return
+                seq += 1
+                await asyncio.sleep(interval)
+
+        tasks.append(asyncio.ensure_future(updater_loop()))
+        for r, w in viewer_socks:
+            tasks.append(asyncio.ensure_future(
+                live._read_frames(r, lambda mp: None, stop)))
+        tasks.append(asyncio.ensure_future(
+            live._read_frames(up_reader, lambda mp: None, stop)))
+
+        # -- warmup (jit compiles, fleet auth), then the STEADY window:
+        # the honest p99-under-load measurement, chaos disarmed. The
+        # first cell crossing jit-compiles the handover kernels
+        # (multi-hundred-ms on CPU) — trigger it here, off the clock,
+        # or that one compile stall IS the steady window's p99.
+        await asyncio.sleep(p.warmup_s / 2)
+        crowd = sim.storm_gather()
+        await asyncio.sleep(1.0)
+        sim.disperse(crowd)
+        for _ in range(6):
+            sim.jitter_step()
+            await asyncio.sleep(0.1)
+        await asyncio.sleep(p.warmup_s / 2)
+        steady_base = scrape()
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < p.steady_s:
+            sim.jitter_step()
+            await asyncio.sleep(0.1)
+        steady_delta = delta(scrape(), steady_base)
+        steady = _delivery_stats(steady_delta)
+
+        # /healthz + /introspect + /readyz while serving.
+        code, health = _http(ops.port, "/healthz")
+        http_log.append({"path": "/healthz", "code": code})
+        healthz_ok = code == 200 and health.get("ok") is True
+        code, intro = _http(ops.port, "/introspect")
+        http_log.append({"path": "/introspect", "code": code})
+        introspect_ok = (
+            code == 200 and intro.get("ready") is True
+            and intro.get("connections", {}).get("CLIENT", 0) >= p.clients
+            and "delivery_p99" in intro.get("slo", {})
+        )
+        code, _ = _http(ops.port, "/metrics")
+        metrics_ok = code == 200
+
+        # -- /readyz flip matrix: device-guard FAILED, then WAL writer
+        # death, each flipping 200 -> 503 -> 200.
+        readyz: dict[str, list] = {"codes": []}
+
+        def _ready_code() -> int:
+            code, _doc = _http(ops.port, "/readyz")
+            readyz["codes"].append(code)
+            return code
+
+        flip_ok = _ready_code() == 200
+        guard._set_state(DeviceState.FAILED)
+        flip_ok = _ready_code() == 503 and flip_ok
+        guard._set_state(DeviceState.ACTIVE)
+        flip_ok = _ready_code() == 200 and flip_ok
+        wal_dir = os.path.join(dump_dir, "obs_wal")
+        os.makedirs(wal_dir, exist_ok=True)
+        global_settings.wal_path = os.path.join(wal_dir, "g.wal")
+        wal.start(global_settings.wal_path)
+        flip_ok = _ready_code() == 200 and flip_ok
+        wal._wedged = True  # the torn-write power-loss state
+        flip_ok = _ready_code() == 503 and flip_ok
+        wal._wedged = False
+        flip_ok = _ready_code() == 200 and flip_ok
+        wal.stop()
+        global_settings.wal_path = ""
+
+        # -- the BREACH window: seeded chaos stalls message handling;
+        # delivery + tick_budget burn past the alarm. The tracker is
+        # re-armed fresh first: on a loaded CPU box the boot-compile
+        # stalls can burn the 60s budget during warmup and latch the
+        # alarm — the leg proves a clean rising edge -> alarm -> dump.
+        slo.configure(enabled=True)
+        breaches_before: dict = {}
+        metric_before = {
+            s: sample_total(None, "slo_breaches_total", slo=s)
+            for s in slo.status()
+        }
+        arm(p.scenario)
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < p.breach_s:
+            sim.jitter_step()
+            await asyncio.sleep(0.1)
+        fire_counts = dict(chaos.fire_counts())
+        disarm()
+        await asyncio.sleep(p.quiesce_s)
+        send_stop.set()
+        await asyncio.sleep(0.5)
+
+        breach_delta = {
+            k: v - breaches_before.get(k, 0)
+            for k, v in slo.breach_counts.items()
+            if v - breaches_before.get(k, 0) > 0
+        }
+        # Double entry: python ledger == prometheus counter, exactly
+        # (the counter delta over the breach window — the registry is
+        # process-cumulative, the ledger was re-armed with the tracker).
+        ledger_exact = all(
+            slo.breach_counts[s] == int(
+                sample_total(None, "slo_breaches_total", slo=s)
+                - metric_before.get(s, 0.0))
+            for s in slo.breach_counts
+        )
+        breach_dumps = [
+            {"trigger": a["trigger"], "detail": a["detail"],
+             "tick": a["tick"], "path": os.path.basename(a["path"]),
+             "perfetto_valid": _check_perfetto(a["path"])[0]}
+            for a in recorder.anomalies
+            if a["trigger"] == "slo_breach" and "path" in a
+        ]
+        burn_peak = {
+            name: max(e["burn"] for e in slo.breach_events
+                      if e["slo"] == name)
+            for name in {e["slo"] for e in slo.breach_events}
+        }
+
+        full_delta = delta(scrape(), baseline)
+        report = {
+            "steady": steady,
+            "full_run": _delivery_stats(full_delta),
+            "delivery_total": slo.delivery_total,
+            "slo_status": slo.status(),
+            "breaches": breach_delta,
+            "breach_ledger_matches_metric": ledger_exact,
+            "breach_dumps": breach_dumps,
+            "burn_peak": burn_peak,
+            "staleness_samples": int(sample_total(
+                full_delta, "fanout_staleness_ms_count")),
+            "readyz": readyz["codes"],
+            "readyz_flip_ok": flip_ok,
+            "healthz_ok": healthz_ok,
+            "introspect_ok": introspect_ok,
+            "metrics_ok": metrics_ok,
+            "ops_port": ops.port,
+            "chaos_fires": fire_counts,
+            "clients": p.clients,
+            "viewers": p.viewers,
+            "frames_sent": sum(stats.client_sent.values()),
+        }
+        stop.set()
+        return report
+    finally:
+        stop.set()
+        send_stop.set()
+        disarm()
+        for t in tasks:
+            t.cancel()
+        server_srv.close()
+        client_srv.close()
+        opshttp.reset_ops()
+        from channeld_tpu.core.slo import reset_slo
+        from channeld_tpu.core.device_guard import reset_device_guard
+
+        reset_device_guard()
+        reset_slo()
+        channel_mod.reset_channels()
+        connection_mod.reset_connections()
+        data_mod.reset_registries()
+        ddos_mod.reset_ddos()
+        recovery_mod.reset_recovery()
+        reset_spatial_controller()
+        reset_global_settings()
+        reset_overload()
+
+
+# ---------------------------------------------------------------------------
+# phase 2: 2-gateway fleet federation — digest exactness
+# ---------------------------------------------------------------------------
+
+
+async def remote_main(args) -> None:
+    """Gateway b: federation-soak boot with the SLO plane + control
+    plane re-armed; reports its own digest on command so the parent
+    can prove the stored copy exact."""
+    with open(args.config) as f:
+        fed_cfg = json.load(f)
+    p = fed.FedSoakParams(heartbeat_ms=200, trunk_timeout_ms=1200,
+                          handover_timeout_ms=1500)
+
+    def hook(gs) -> None:
+        gs.slo_enabled = True
+        gs.global_control_enabled = True
+        gs.global_epoch_ms = args.epoch_ms
+
+    stop = asyncio.Event()
+    gw = await fed.boot_gateway("b", fed_cfg, p, stop, settings_hook=hook)
+    from channeld_tpu.core.slo import slo
+
+    slo.configure(enabled=True)
+    print("READY", flush=True)
+
+    loop = asyncio.get_running_loop()
+    reader = asyncio.StreamReader()
+    await loop.connect_read_pipe(
+        lambda: asyncio.StreamReaderProtocol(reader), sys.stdin
+    )
+    from channeld_tpu.federation.obs import build_local_digest
+
+    while True:
+        line = await reader.readline()
+        if not line:
+            break
+        try:
+            cmd = json.loads(line)
+        except ValueError:
+            continue
+        if cmd.get("cmd") == "report":
+            with open(args.report, "w") as f:
+                json.dump({"gateway": "b",
+                           "digest": build_local_digest()}, f)
+            print("OK report", flush=True)
+        elif cmd.get("cmd") == "exit":
+            break
+    stop.set()
+    fed.teardown_gateway(gw)
+
+
+async def run_federation_phase(p: ObsSoakParams) -> dict:
+    from channeld_tpu.core import opshttp
+    from channeld_tpu.core.settings import global_settings
+    from channeld_tpu.core.slo import slo
+    from channeld_tpu.federation.obs import fleet, merge_digests
+
+    ports = dict(zip(
+        ("a_trunk", "a_client", "b_trunk", "b_client"), fed._free_ports(4)
+    ))
+    fed_cfg = fed._fed_config(ports)
+    cfg_path = os.path.join("/tmp", f"obs_soak_cfg_{os.getpid()}.json")
+    report_path = os.path.join("/tmp", f"obs_soak_report_{os.getpid()}.json")
+    with open(cfg_path, "w") as f:
+        json.dump(fed_cfg, f)
+
+    child_proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--role", "remote",
+         "--config", cfg_path, "--report", report_path,
+         "--epoch-ms", str(p.fed_epoch_ms)],
+        cwd=REPO, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    child = fed.Child(child_proc)
+    stop = asyncio.Event()
+    gw = None
+    fp = fed.FedSoakParams(heartbeat_ms=200, trunk_timeout_ms=1200,
+                           handover_timeout_ms=1500)
+
+    def hook(gs) -> None:
+        gs.slo_enabled = True
+        gs.global_control_enabled = True
+        gs.global_epoch_ms = p.fed_epoch_ms
+
+    try:
+        await child.wait_for("READY", 60.0)
+        gw = await fed.boot_gateway("a", fed_cfg, fp, stop,
+                                    settings_hook=hook)
+        plane = gw["plane"]
+        slo.configure(enabled=True)
+        ops = opshttp.serve_ops(0, host="127.0.0.1")
+
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline and plane.link_to("b") is None:
+            await asyncio.sleep(0.05)
+        if plane.link_to("b") is None:
+            raise RuntimeError("trunk to b never came up")
+
+        # Cross-gateway traffic so the digests carry real numbers.
+        rng = Random(p.seed ^ 0xFED)
+        sim = fed.FedSim(gw["ctl"], rng)
+        sim.create_entities(8, -98.0, -2.0, -98.0, 98.0)
+        await asyncio.sleep(0.5)
+        sim.herd(sim.entity_ids[:4], 2.0, 98.0, -98.0, 98.0)
+
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < p.fed_run_s:
+            await asyncio.sleep(0.2)
+
+        # Quiesce: let the digest families go static, then wait out two
+        # more epochs so b's LAST export reflects the static state.
+        await asyncio.sleep(max(4 * p.fed_epoch_ms / 1000.0, 1.0))
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and "b" not in fleet.digests:
+            await asyncio.sleep(0.1)
+        if "b" not in fleet.digests:
+            raise RuntimeError("b's metric digest never arrived")
+
+        await child.cmd("report", timeout=15.0)
+        with open(report_path) as f:
+            b_self = json.load(f)["digest"]
+        b_stored = fleet.digests["b"][0]
+
+        # Exactness leg 1: the digest stored on a IS b's own ledger.
+        mismatches = []
+        for section in ("counters", "gauges"):
+            for family, rows in b_self[section].items():
+                stored_rows = b_stored.get(section, {}).get(family, {})
+                for key, v in rows.items():
+                    if abs(stored_rows.get(key, 0.0) - v) > 1e-9:
+                        mismatches.append(
+                            f"{section}:{family}{key} self={v} "
+                            f"stored={stored_rows.get(key)}")
+        # Exactness leg 2: every family/labelset in a's rendered /fleet
+        # equals the element-wise sum of the two per-gateway digests.
+        a_digest = fleet.refresh_local()
+        merged = merge_digests([a_digest, b_stored])
+        code, text = _http(ops.port, "/fleet", timeout=5.0)
+        rendered = parse_exposition(text) if code == 200 else {}
+        checked = 0
+        for family, rows in merged["counters"].items():
+            for key, v in rows.items():
+                pairs = json.loads(key)
+                labels = ("{" + ",".join(
+                    f'{k}="{val}"' for k, val in pairs) + "}"
+                ) if pairs else ""
+                got = rendered.get((f"fleet_{family}_total", labels))
+                checked += 1
+                if got is None or abs(got - v) > 1e-9:
+                    mismatches.append(
+                        f"/fleet fleet_{family}_total{labels} "
+                        f"got={got} want={v}")
+        code_json, fleet_json = _http(ops.port, "/fleet?format=json")
+        return {
+            "digest_exact": not mismatches,
+            "mismatches": mismatches[:20],
+            "labelsets_checked": checked,
+            "gateways_in_fleet": sorted(fleet.digests),
+            "fleet_json_ok": (
+                code_json == 200
+                and fleet_json.get("gateways", {})
+                            .get("b", {}).get("up") is True
+            ),
+            "leader": (fleet_json.get("leader", "")
+                       if code_json == 200 else ""),
+            "committed_handovers": plane.ledger.get("committed", 0),
+            "trunk_rtt_slo_tracked":
+                "trunk_rtt" in slo.status(),
+        }
+    finally:
+        stop.set()
+        try:
+            if child_proc.poll() is None:
+                try:
+                    child_proc.stdin.write('{"cmd": "exit"}\n')
+                    child_proc.stdin.flush()
+                except (BrokenPipeError, OSError):
+                    pass
+                try:
+                    child_proc.wait(timeout=8)
+                except subprocess.TimeoutExpired:
+                    child_proc.kill()
+        except Exception:
+            pass
+        from channeld_tpu.core import opshttp as opshttp_mod
+        from channeld_tpu.core.slo import reset_slo
+        from channeld_tpu.federation.obs import reset_fleet_obs
+
+        opshttp_mod.reset_ops()
+        reset_slo()
+        reset_fleet_obs()
+        if gw is not None:
+            fed.teardown_gateway(gw)
+        for path in (cfg_path, report_path):
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# phase 3: SLO plane overhead on the tick hot path
+# ---------------------------------------------------------------------------
+
+
+def run_overhead_phase(p: ObsSoakParams) -> dict:
+    """The synchronous GLOBAL tick (device step + stamped updates +
+    subscribed fan-out) with the SLO plane enabled vs disabled —
+    per-tick-alternated arms, medians (trace_soak's method; the bar
+    here is < 2%)."""
+    from channeld_tpu.core import channel as channel_mod
+    from channeld_tpu.core.channel import create_channel, init_channels
+    from channeld_tpu.core.settings import (
+        ChannelSettings,
+        global_settings,
+        reset_global_settings,
+    )
+    from channeld_tpu.core.slo import slo
+    from channeld_tpu.core.tracing import recorder
+    from channeld_tpu.core.types import ChannelDataAccess, ChannelType
+    from channeld_tpu.models.sim import register_sim_types, sim_pb2
+    from channeld_tpu.protocol import control_pb2
+    from channeld_tpu.spatial.controller import (
+        SpatialInfo,
+        reset_spatial_controller,
+        set_spatial_controller,
+    )
+    from channeld_tpu.spatial.tpu_controller import TPUSpatialController
+
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    from helpers import StubConnection  # noqa: E402
+
+    channel_mod.reset_channels()
+    reset_spatial_controller()
+    reset_global_settings()
+    global_settings.development = False
+    global_settings.tpu_entity_capacity = 256
+    global_settings.tpu_query_capacity = 16
+    global_settings.overload_enabled = False
+    global_settings.trace_enabled = True
+    recorder.configure(enabled=True, ring_spans=16384, dump_path="/tmp",
+                       anomaly_cooldown_s=1e9)
+    recorder._last_dump_at = time.monotonic()
+    global_settings.channel_settings = {
+        ChannelType.GLOBAL: ChannelSettings(
+            tick_interval_ms=10, default_fanout_interval_ms=20),
+        ChannelType.SUBWORLD: ChannelSettings(
+            tick_interval_ms=10, default_fanout_interval_ms=20),
+    }
+    register_sim_types()
+    init_channels()
+    gch = channel_mod.get_global_channel()
+    ctl = TPUSpatialController()
+    ctl.load_config(dict(
+        WorldOffsetX=0, WorldOffsetZ=0, GridWidth=100, GridHeight=100,
+        GridCols=4, GridRows=4, ServerCols=1, ServerRows=1,
+        ServerInterestBorderSize=0,
+    ))
+    set_spatial_controller(ctl)
+    rng = Random(p.seed ^ 0x0b5d)
+    estart = global_settings.entity_channel_id_start
+    eids = []
+    for i in range(64):
+        eid = estart + 1 + i
+        x = (i % 4) * 100.0 + 50.0
+        z = (i // 4 % 4) * 100.0 + 50.0
+        ctl.track_entity(eid, SpatialInfo(x, 0, z))
+        eids.append((eid, x, z))
+
+    # A subscribed SUBWORLD channel so the enabled arm pays the real
+    # per-window delivery sampling + the GLOBAL burn-rate evaluation.
+    from channeld_tpu.core.subscription import subscribe_to_channel
+
+    sub_ch = create_channel(ChannelType.SUBWORLD, None)
+    sub_ch.init_data(sim_pb2.SimSpatialChannelData(), None)
+    for i in range(8):
+        subscribe_to_channel(
+            StubConnection(9000 + i), sub_ch,
+            control_pb2.ChannelSubscriptionOptions(
+                dataAccess=ChannelDataAccess.READ_ACCESS,
+                fanOutIntervalMs=10, skipSelfUpdateFanOut=False))
+
+    slo.configure(enabled=True)
+    seq = [0]
+
+    def one_tick() -> int:
+        for eid, x, z in rng.sample(eids, 8):
+            ctl.observe_entity(eid, SpatialInfo(
+                x + rng.uniform(-20, 20), 0, z + rng.uniform(-20, 20)))
+        upd = sim_pb2.SimSpatialChannelData()
+        e = estart + 2000
+        upd.entities[e].entityId = e
+        upd.entities[e].transform.position.x = float(seq[0] % 89)
+        seq[0] += 1
+        sub_ch.data.on_update(
+            upd, sub_ch.get_time(), 999,
+            now_ns=sub_ch.get_time(), ingest_ns=time.monotonic_ns())
+        t0 = time.perf_counter_ns()
+        gch.tick_once(gch.get_time())
+        sub_ch.tick_once(sub_ch.get_time())
+        return time.perf_counter_ns() - t0
+
+    for _ in range(30):  # jit warmup off the clock
+        one_tick()
+    import gc
+
+    on_samples: list[int] = []
+    off_samples: list[int] = []
+    gc_was_enabled = gc.isenabled()
+    gc.disable()
+    try:
+        for _ in range(p.overhead_ticks * p.overhead_rounds):
+            slo.enabled = True
+            on_samples.append(one_tick())
+            slo.enabled = False
+            off_samples.append(one_tick())
+    finally:
+        if gc_was_enabled:
+            gc.enable()
+    slo.enabled = True
+
+    tick_on = statistics.median(on_samples)
+    tick_off = statistics.median(off_samples)
+    overhead_pct = (tick_on - tick_off) / tick_off * 100.0
+
+    from channeld_tpu.core.slo import reset_slo
+
+    reset_slo()
+    channel_mod.reset_channels()
+    reset_spatial_controller()
+    reset_global_settings()
+    recorder.reset()
+    return {
+        "tick_ns_enabled": int(tick_on),
+        "tick_ns_disabled": int(tick_off),
+        "overhead_pct": round(overhead_pct, 3),
+        "ticks_per_round": p.overhead_ticks,
+        "rounds": p.overhead_rounds,
+        "method": "median per-tick over per-tick-alternated "
+                  "enabled/disabled arms of the synchronous GLOBAL + "
+                  "SUBWORLD tick (device step, 8 entity updates/tick, "
+                  "one stamped channel update/tick fanned out to 8 "
+                  "subscribers, burn-rate eval every GLOBAL tick; gc "
+                  "off, no dump I/O in-window)",
+    }
+
+
+# ---------------------------------------------------------------------------
+# the soak
+# ---------------------------------------------------------------------------
+
+
+async def run_obs_soak(p: ObsSoakParams) -> dict:
+    from channeld_tpu.chaos.invariants import InvariantChecker
+
+    t_start = time.monotonic()
+    dump_dir = os.path.join(REPO, "profiles")
+    live_report = await run_live_phase(p, dump_dir)
+    fed_report = None
+    if not p.skip_federation:
+        fed_report = await run_federation_phase(p)
+    overhead = run_overhead_phase(p)
+
+    # The north-star verdict, recorded honestly whichever way it lands:
+    # the steady-window host-path p99 on the measured channel.
+    steady = live_report["steady"]
+    host_key = next((k for k in steady if k.endswith("/host")), None)
+    p99 = steady[host_key]["p99_ms"] if host_key else None
+    under_5 = bool(p99 is not None and p99 < 5.0)
+
+    inv = InvariantChecker()
+    p50 = steady[host_key]["p50_ms"] if host_key else None
+    inv.check("delivery_p99_measured_under_load",
+              p99 is not None and steady[host_key]["count"] > 100,
+              f"steady window: {steady}")
+    inv.check("delivery_p99_bounded",
+              p99 is not None and p99 < 1000.0,
+              f"p99={p99}ms (runaway-window-lag detector: a fan-out "
+              f"window falling cumulatively behind real time rides "
+              f"into the top/overflow buckets; the <5ms verdict is "
+              f"recorded separately: {under_5})")
+    inv.check("delivery_p50_bounded",
+              p50 is not None and p50 < 100.0,
+              f"p50={p50}ms (the typical-case bound a broken stamp "
+              f"pipeline or lagging window would blow; tail stalls on "
+              f"a loaded CPU box land in p99, recorded honestly)")
+    inv.expect_gt("slo_breach_fired",
+                  sum(live_report["breaches"].values()), 0)
+    inv.check("breach_ledger_matches_metric",
+              live_report["breach_ledger_matches_metric"], "")
+    inv.check("breach_anomaly_dump_perfetto_valid",
+              bool(live_report["breach_dumps"])
+              and all(d["perfetto_valid"]
+                      for d in live_report["breach_dumps"]),
+              str(live_report["breach_dumps"]))
+    inv.check("readyz_flipped_on_device_fault",
+              live_report["readyz_flip_ok"],
+              f"codes: {live_report['readyz']}")
+    inv.check("healthz_and_introspect_served",
+              live_report["healthz_ok"] and live_report["introspect_ok"]
+              and live_report["metrics_ok"], "")
+    inv.expect_gt("staleness_sampled",
+                  live_report["staleness_samples"], 0)
+    if fed_report is not None:
+        inv.check("fleet_digest_exact", fed_report["digest_exact"],
+                  str(fed_report["mismatches"]))
+        inv.expect_gt("fleet_labelsets_checked",
+                      fed_report["labelsets_checked"], 20)
+        inv.check("fleet_json_and_leader",
+                  fed_report["fleet_json_ok"]
+                  and fed_report["leader"] != "", str(fed_report))
+    inv.expect_le("obs_overhead_under_2pct",
+                  overhead["overhead_pct"], 2.0)
+
+    report = {
+        "kind": "obs_soak",
+        "duration_s": round(time.monotonic() - t_start, 2),
+        "params": {
+            "steady_s": p.steady_s, "breach_s": p.breach_s,
+            "clients": p.clients, "viewers": p.viewers,
+            "update_rate": p.update_rate, "seed": p.seed,
+        },
+        "scenario": p.scenario,
+        "delivery": {
+            "steady": live_report["steady"],
+            "full_run": live_report["full_run"],
+            "total_samples": live_report["delivery_total"],
+            "p99_ms": p99,
+            "p99_under_5ms": under_5,
+            "note": (
+                "steady-window host-path p99 on the measured SUBWORLD "
+                "channel (5ms tick / 10ms fan-out interval), CPU "
+                "gateway under live socket load; the delivery number "
+                "includes the fan-out decision cadence — verdict "
+                "recorded honestly either way (ROADMAP item 3's TPU "
+                "full-population run remains open)"),
+        },
+        "slo": live_report["slo_status"],
+        "breaches": {
+            "counts": live_report["breaches"],
+            "burn_peak": live_report["burn_peak"],
+            "ledger_matches_metric":
+                live_report["breach_ledger_matches_metric"],
+            "dumps": live_report["breach_dumps"],
+        },
+        "readyz": {
+            "codes": live_report["readyz"],
+            "flip_ok": live_report["readyz_flip_ok"],
+            "matrix": "200 baseline -> 503 device FAILED -> 200 "
+                      "recovered -> 200 WAL armed -> 503 writer "
+                      "wedged -> 200 unwedged",
+        },
+        "fleet": (fed_report if fed_report is not None
+                  else {"skipped": True}),
+        "overhead": overhead,
+        "live": {k: live_report[k] for k in
+                 ("chaos_fires", "clients", "viewers", "frames_sent",
+                  "staleness_samples", "ops_port")},
+        "invariants": inv.summary(),
+    }
+    if p.out_path:
+        with open(p.out_path, "w") as f:
+            json.dump(report, f, indent=2)
+    return report
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--role", choices=("soak", "remote"), default="soak")
+    ap.add_argument("--config", type=str, default="")
+    ap.add_argument("--report", type=str, default="")
+    ap.add_argument("--epoch-ms", type=int, default=200)
+    ap.add_argument("--steady-s", type=float, default=15.0)
+    ap.add_argument("--breach-s", type=float, default=12.0)
+    ap.add_argument("--clients", type=int, default=10)
+    ap.add_argument("--skip-federation", action="store_true")
+    ap.add_argument("--out", type=str, default="")
+    args = ap.parse_args()
+    if args.role == "remote":
+        asyncio.run(remote_main(args))
+        return
+    p = ObsSoakParams(
+        steady_s=args.steady_s, breach_s=args.breach_s,
+        clients=args.clients, skip_federation=args.skip_federation,
+        out_path=args.out,
+    )
+    report = asyncio.run(run_obs_soak(p))
+    print(json.dumps(report, indent=2))
+    if not report["invariants"]["ok"]:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

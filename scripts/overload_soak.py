@@ -206,6 +206,10 @@ async def run_overload_soak(p: OverloadSoakParams) -> dict:
     # any chaos-adjacent retry would perturb it. The device plane's
     # own soak is scripts/device_soak.py.
     global_settings.device_guard_enabled = False
+    # SLO plane pinned OFF (doc/observability.md): this soak's
+    # envelope predates the delivery-latency sampling; the health
+    # plane has its own soak (scripts/obs_soak.py).
+    global_settings.slo_enabled = False
     # Flight recorder pinned OFF (doc/observability.md): these soaks
     # prove deterministic accounting and timing envelopes; span
     # recording and anomaly auto-dumps must not perturb either

@@ -203,6 +203,10 @@ async def run_live_phase(p: TraceSoakParams, dump_dir: str) -> dict:
     # any chaos-adjacent retry would perturb it. The device plane's
     # own soak is scripts/device_soak.py.
     global_settings.device_guard_enabled = False
+    # SLO plane pinned OFF (doc/observability.md): this soak's
+    # envelope predates the delivery-latency sampling; the health
+    # plane has its own soak (scripts/obs_soak.py).
+    global_settings.slo_enabled = False
     global_settings.federation_config = ""
     # The ladder stays pinned at L0: boot-time jit compiles blow ticks,
     # and on a loaded box the resulting climb reaches L3 before the

@@ -62,7 +62,10 @@ def _fresh_globals(tmp_path):
 
     tracing.recorder.configure(dump_path=str(tmp_path))
     yield
+    from channeld_tpu.core import opshttp as opshttp_mod
+    from channeld_tpu.core import slo as slo_mod
     from channeld_tpu.core import wal as wal_mod
+    from channeld_tpu.federation import obs as obs_mod
 
     events.reset_all()
     settings.reset_global_settings()
@@ -71,3 +74,8 @@ def _fresh_globals(tmp_path):
     device_guard.reset_device_guard()
     tracing.reset_tracing()
     wal_mod.reset_wal()
+    # SLO/fleet-obs state and any ops HTTP server a test started are
+    # torn down too (tests bind ephemeral ports via serve_ops(0)).
+    slo_mod.reset_slo()
+    obs_mod.reset_fleet_obs()
+    opshttp_mod.reset_ops()

@@ -207,6 +207,89 @@ def test_crash_soak_schema_gate(tmp_path):
                for e in check_artifacts.check_artifacts(str(tmp_path)))
 
 
+def _obs_soak_doc():
+    return {
+        "kind": "obs_soak",
+        "invariants": {"ok": True, "checks": [
+            {"name": n, "ok": True} for n in (
+                "delivery_p99_measured_under_load",
+                "delivery_p99_bounded",
+                "delivery_p50_bounded",
+                "slo_breach_fired",
+                "breach_ledger_matches_metric",
+                "breach_anomaly_dump_perfetto_valid",
+                "readyz_flipped_on_device_fault",
+                "healthz_and_introspect_served",
+                "staleness_sampled",
+                "fleet_digest_exact",
+                "obs_overhead_under_2pct",
+            )
+        ]},
+        "delivery": {"p99_ms": 7.9, "p99_under_5ms": False,
+                     "steady": {}, "note": "honest"},
+        "slo": {"delivery_p99": {}},
+        "breaches": {"counts": {"delivery_p99": 1},
+                     "ledger_matches_metric": True,
+                     "dumps": [{"trigger": "slo_breach",
+                                "perfetto_valid": True}]},
+        "readyz": {"codes": [200, 503, 200], "flip_ok": True},
+        "fleet": {"digest_exact": True, "labelsets_checked": 40},
+        "overhead": {"overhead_pct": 0.4},
+    }
+
+
+def test_obs_soak_schema_gate(tmp_path):
+    """OBS_*.json extra checks (doc/observability.md): a clean
+    artifact passes — including one honestly recording the < 5ms
+    verdict as FALSE; a missing p99 record, a missing breach, an
+    invalid dump, an unproven digest, a blown overhead bound and a
+    missing invariant name are each flagged."""
+    import json
+
+    path = tmp_path / "OBS_r99.json"
+    path.write_text(json.dumps(_obs_soak_doc()))
+    assert check_artifacts.check_artifacts(str(tmp_path)) == []
+
+    doc = _obs_soak_doc()
+    del doc["delivery"]["p99_under_5ms"]
+    path.write_text(json.dumps(doc))
+    assert any("verdict not recorded" in e
+               for e in check_artifacts.check_artifacts(str(tmp_path)))
+
+    doc = _obs_soak_doc()
+    doc["breaches"]["counts"] = {}
+    path.write_text(json.dumps(doc))
+    assert any("no SLO breach recorded" in e
+               for e in check_artifacts.check_artifacts(str(tmp_path)))
+
+    doc = _obs_soak_doc()
+    doc["breaches"]["dumps"][0]["perfetto_valid"] = False
+    path.write_text(json.dumps(doc))
+    assert any("breach dumps missing/invalid" in e
+               for e in check_artifacts.check_artifacts(str(tmp_path)))
+
+    doc = _obs_soak_doc()
+    doc["fleet"] = {"digest_exact": False}
+    path.write_text(json.dumps(doc))
+    assert any("digest exactness not proven" in e
+               for e in check_artifacts.check_artifacts(str(tmp_path)))
+
+    doc = _obs_soak_doc()
+    doc["overhead"]["overhead_pct"] = 3.5
+    path.write_text(json.dumps(doc))
+    assert any("overhead bound not proven" in e
+               for e in check_artifacts.check_artifacts(str(tmp_path)))
+
+    doc = _obs_soak_doc()
+    doc["invariants"]["checks"] = [
+        c for c in doc["invariants"]["checks"]
+        if c["name"] != "fleet_digest_exact"
+    ]
+    path.write_text(json.dumps(doc))
+    assert any("missing invariant check 'fleet_digest_exact'" in e
+               for e in check_artifacts.check_artifacts(str(tmp_path)))
+
+
 def test_artifact_metric_refs_are_checked():
     """Committed artifacts citing metrics must cite registered families
     with the declared label sets (scripts/check_artifacts.py
