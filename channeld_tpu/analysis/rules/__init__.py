@@ -1,6 +1,13 @@
 """tpulint rule registry (doc/analysis.md#adding-a-rule)."""
 
 from .accounting import DoubleEntryRule
+from .affinity import (
+    FenceDisciplineRule,
+    LiveIterRule,
+    OffLoopAsyncioRule,
+    SharedStateRule,
+    ThreadModelRule,
+)
 from .async_blocking import AsyncBlockingRule
 from .excepts import ExceptHygieneRule
 from .proto_drift import ProtoDriftRule
@@ -14,6 +21,11 @@ ALL_RULES = (
     DoubleEntryRule,
     ExceptHygieneRule,
     HistogramUnitsRule,
+    ThreadModelRule,
+    SharedStateRule,
+    OffLoopAsyncioRule,
+    FenceDisciplineRule,
+    LiveIterRule,
 )
 
 

@@ -44,6 +44,21 @@ SCOPE: tuple[tuple[str, str], ...] = (
     # I/O error handling and never runs on the tick path.
     ("channeld_tpu/core/wal.py",
      r"^(append|note_dirty|on_global_tick|log_|_count_)"),
+    # Fleet health plane (PR 13, doc/observability.md): the SLO
+    # evaluation + staleness sample run inside the GLOBAL tick and the
+    # breach ledger is double-entry — a swallowed failure here makes
+    # the soak's ledger==metric assertion lie. The ops probes
+    # (core/opshttp.py readiness/introspect) are the matching runtime
+    # surface: a component probe that swallows its error reports a
+    # half-truth to the orchestrator.
+    ("channeld_tpu/core/slo.py",
+     r"^(on_global_tick|_evaluate|_feed|record_delivery|observe|"
+     r"_sample_staleness|_rebuild_sample_ring|_count_breach)$"),
+    ("channeld_tpu/core/opshttp.py",
+     r"^(do_GET|readiness|introspect|_shard_ready|_device_ready|"
+     r"_wal_ready|_trunk_ready)$"),
+    ("channeld_tpu/federation/obs.py",
+     r"^(attach_digest|store_peer|refresh_local|merged|render_)"),
 )
 
 _LOG_OK = {"warning", "error", "exception", "critical"}

@@ -53,6 +53,21 @@ HOT_PATHS: tuple[tuple[str, str], ...] = (
     # lives on the off-thread writer, which is out of scope by design).
     ("channeld_tpu/core/wal.py",
      r"^(append|note_dirty|on_global_tick|log_)"),
+    # Fleet health plane (PR 13): the per-tick SLO hooks and the
+    # staleness sample run inside the GLOBAL tick (the 24µs hot-path
+    # budget doc/observability.md pins); the digest build/attach runs
+    # on the control epoch inside the tick too. The ops handlers are
+    # off-loop but still must not touch engine arrays — an /introspect
+    # that syncs the device would stall the worker's dispatch queue.
+    ("channeld_tpu/core/slo.py",
+     r"^(on_global_tick|_evaluate|_feed|record_delivery|observe|"
+     r"_sample_staleness|_rebuild_sample_ring)$"),
+    ("channeld_tpu/core/opshttp.py",
+     r"^(do_GET|readiness|introspect|_shard_ready|_device_ready|"
+     r"_wal_ready|_trunk_ready)$"),
+    ("channeld_tpu/federation/obs.py",
+     r"^(build_local_digest|attach_digest|store_peer|refresh_local|"
+     r"merged|merge_digests|render_)"),
 )
 
 # Calls that force a device->host transfer for ONE row/scalar.

@@ -79,7 +79,10 @@ class ChaosInjector:
         self._points: dict[str, _PointState] = {}
         self._armed_at = 0.0
         self.scenario: Optional[Scenario] = None
-        self.journal: list[dict] = []
+        # Fired from every domain (tick-loop, WAL writer, device
+        # worker): one GIL-atomic list append per event, read only by
+        # soak teardown (doc/concurrency.md).
+        self.journal: list[dict] = []  # tpulint: shared=atomic
 
     # ---- lifecycle -------------------------------------------------------
 

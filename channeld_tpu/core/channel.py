@@ -31,6 +31,7 @@ from .data import (
     _channel_data_extension_registry,
     register_channel_data_type,
 )
+from .affinity import affinity as _affinity
 from .overload import governor as _governor
 from .settings import global_settings
 from .slo import slo as _slo
@@ -470,6 +471,11 @@ class Channel:
 
         self.tick_frames += 1
         if self.channel_type == ChannelType.GLOBAL:
+            # The GLOBAL tick is the authoritative loop-thread anchor:
+            # it (re)binds the tick-loop affinity domain every tick, so
+            # every expect() downstream checks against THIS thread
+            # (doc/concurrency.md; disarmed = one attribute load).
+            _affinity.enter("tick-loop")
             # The GLOBAL tick is the recorder's clock: every span this
             # tick (any channel, any stage) is stamped with this number,
             # which is what lets a dump say "tick 8041 spent 9.3ms in
@@ -588,7 +594,7 @@ class Channel:
                     # backpressure lift in finally must still run).
                     stall = _chaos.stall_s("channel.tick_budget")
                     if stall:
-                        time.sleep(stall)
+                        time.sleep(stall)  # tpulint: disable=async-blocking -- chaos-injected stall MODELS a slow handler eating the tick budget (doc/chaos.md); blocking is the point
                 if qm.ctx is None:
                     continue
                 if (

@@ -66,6 +66,7 @@ import numpy as np
 
 from ..chaos.injector import chaos as _chaos
 from ..utils.logger import get_logger
+from .affinity import affinity as _affinity
 from .settings import global_settings
 
 logger = get_logger("device_guard")
@@ -212,6 +213,9 @@ class DeviceGuard:
         readback arrays already materialized on host — or None while the
         engine is down/held (the controller must hold all
         device-dependent work for that tick)."""
+        # Affinity: the guard's state machine is loop-thread-only; all
+        # device waits happen on the worker via _dispatch.
+        _affinity.expect("tick-loop")
         now = time.monotonic()
         if self.state != DeviceState.ACTIVE:
             if now < self._not_before:
@@ -297,6 +301,7 @@ class DeviceGuard:
         """Worker-thread body: chaos gates, the engine step, and the
         batched readback fetch — ALL device waits happen here so the
         watchdog deadline covers dispatch and transfer alike."""
+        _affinity.enter("device-worker")
         if _chaos.armed:
             stall = _chaos.stall_s("device.step_hang")
             if stall:
@@ -488,6 +493,7 @@ class DeviceGuard:
         commit once the watchdog bumped the generation — the stale
         worker raises AFTER its blocking transfers, BEFORE any
         engine-visible mutation."""
+        _affinity.enter("device-worker")
         if not engine._rebuild_lock.acquire(
             timeout=max(global_settings.device_step_deadline_s * 4, 0.004)
         ):

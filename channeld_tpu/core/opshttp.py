@@ -44,6 +44,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from ..utils.logger import get_logger
+from .affinity import affinity as _affinity
 
 logger = get_logger("opshttp")
 
@@ -118,7 +119,10 @@ def _trunk_ready() -> tuple[bool, str]:
         return True, "no peers configured"
     mgr = getattr(plane, "manager", None)
     links = getattr(mgr, "links", {}) if mgr is not None else {}
-    live = sorted(p for p, ln in links.items() if ln.alive)
+    # list() first: this probe runs on an ops HTTP thread while the
+    # loop installs/drops links — a generator over the live dict would
+    # race the mutation across bytecode boundaries (doc/concurrency.md).
+    live = sorted(p for p, ln in list(links.items()) if ln.alive)
     quorum = (len(peers) + 1) // 2
     if len(live) < quorum:
         return False, (f"trunk quorum lost: {len(live)}/{len(peers)} "
@@ -214,8 +218,9 @@ def introspect() -> dict:
             links = getattr(mgr, "links", {}) if mgr is not None else {}
             doc["federation"] = {
                 "peers": directory.peers(),
+                # snapshot first: ops-thread read vs loop link churn
                 "live_trunks": sorted(
-                    p for p, ln in links.items() if ln.alive),
+                    p for p, ln in list(links.items()) if ln.alive),
                 "directory_version": directory.override_version,
             }
     except Exception as e:
@@ -252,6 +257,7 @@ class _OpsHandler(BaseHTTPRequestHandler):
                     "application/json")
 
     def do_GET(self) -> None:  # noqa: N802 (BaseHTTPRequestHandler API)
+        _affinity.enter("ops-http")
         path, _, query = self.path.partition("?")
         try:
             if path == "/metrics":

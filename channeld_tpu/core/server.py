@@ -592,8 +592,10 @@ async def run_server(argv: Optional[list[str]] = None) -> None:
     # tasks/threads (no -profile tasks pre-arming needed), SIGUSR2 dumps
     # the recorder ring as Perfetto JSON — and the shutdown dump.
     from . import tracing
+    from .affinity import configure_from_settings as configure_affinity
     from .profiling import install_task_dump_signal
 
+    configure_affinity()
     tracing.configure_from_settings()
     install_task_dump_signal(global_settings.profile_path)
     tracing.install_trace_dump_signal()

@@ -333,6 +333,13 @@ class GlobalSettings:
     # empty = the built-in defaults.
     slo_config: str = ""
 
+    # Runtime thread-affinity assertions (doc/concurrency.md): the
+    # static thread model's runtime twin. Off in production by default
+    # (hooks cost one attribute load); tier-1 arms it for the whole
+    # run via tests/conftest.py, and -debug-affinity arms it on a live
+    # gateway (violations are recorded + warned, never raised).
+    debug_affinity: bool = False
+
     # Device mesh for the spatial engine: 0 devices = single-device step;
     # N>0 shards the entity arrays over the first N jax devices, and
     # hosts>1 arranges them as a (hosts, chips) DCN x ICI mesh — the TPU
@@ -556,6 +563,14 @@ class GlobalSettings:
         p.add_argument("-slo-config", type=str, default=self.slo_config,
                        help="JSON SLO table overriding the built-in "
                             "defaults (core/slo.py SloSpec rows)")
+        p.add_argument("-debug-affinity",
+                       type=lambda s: s.lower() not in
+                       ("false", "0", "no", "off"),
+                       default=self.debug_affinity,
+                       help="arm runtime thread-affinity assertions "
+                            "(doc/concurrency.md): violations of the "
+                            "declared thread model are recorded and "
+                            "logged at warning")
         p.add_argument("-mesh-devices", type=int, default=self.tpu_mesh_devices,
                        help="shard the spatial engine over N devices "
                             "(0 = single-device step)")
@@ -627,6 +642,7 @@ class GlobalSettings:
         self.trace_dump_ticks = args.trace_dump_ticks
         self.slo_enabled = args.slo
         self.slo_config = args.slo_config
+        self.debug_affinity = args.debug_affinity
         self.spatial_backend = args.spatial_backend
         self.tpu_mesh_devices = args.mesh_devices
         self.tpu_mesh_hosts = args.mesh_hosts

@@ -52,6 +52,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from ..utils.logger import get_logger
+from .affinity import affinity as _affinity
 
 logger = get_logger("slo")
 
@@ -133,7 +134,7 @@ class _WindowRing:
 
     def __init__(self, span_s: int):
         self.span = span_s
-        self.buckets: dict[int, list] = {}  # second -> [good, bad]
+        self.buckets: dict[int, list] = {}  # second -> [good, bad]  # tpulint: shared=lock
         self.lock = threading.Lock()
 
     def add(self, second: int, bad: bool) -> None:
@@ -279,6 +280,7 @@ class SloPlane:
         sites also guard)."""
         if not self.enabled:
             return
+        _affinity.expect("tick-loop")
         now = time.monotonic()
         if now >= self._next_eval:
             self._next_eval = now + self.eval_interval_s
@@ -446,9 +448,12 @@ class SloPlane:
         return float("inf")
 
     def status(self) -> dict:
-        """Per-SLO burn/alarm snapshot for /introspect and the soaks."""
+        """Per-SLO burn/alarm snapshot for /introspect and the soaks.
+        Runs on the ops HTTP thread: list() snapshots the table first
+        (a concurrent configure() must degrade to a stale read, never a
+        dict-changed-size error in a probe)."""
         out = {}
-        for name, state in self._states.items():
+        for name, state in list(self._states.items()):
             out[name] = {
                 "objective": state.spec.objective,
                 "threshold": state.spec.threshold,

@@ -46,6 +46,7 @@ import time
 from typing import Optional
 
 from ..utils.logger import get_logger
+from .affinity import affinity as _affinity
 
 logger = get_logger("tracing")
 
@@ -198,6 +199,7 @@ class FlightRecorder:
     def set_tick(self, tick: int) -> None:
         """Stamp subsequent spans with the GLOBAL tick number (called
         once per GLOBAL tick)."""
+        _affinity.expect("tick-loop")
         self.tick = tick
 
     # ---- introspection ---------------------------------------------------
@@ -352,6 +354,7 @@ class FlightRecorder:
         record["path"] = path
 
         def _write():
+            _affinity.enter("trace-dumper")
             try:
                 doc = self.to_trace_events(spans)
                 doc["otherData"]["trigger"] = trigger

@@ -76,6 +76,7 @@ from ..chaos.injector import chaos as _chaos
 from ..protocol import wal_pb2
 from ..utils.anyutil import pack_any, unpack_any
 from ..utils.logger import get_logger
+from .affinity import affinity as _affinity
 from .settings import global_settings
 from .types import ChannelType, GLOBAL_CHANNEL_ID
 
@@ -148,16 +149,20 @@ class WriteAheadLog:
         self.reset()
 
     def reset(self) -> None:
-        self.enabled = False
+        # Cross-thread state (doc/concurrency.md): the queue hands
+        # records from the loop to the writer under self._lock; the
+        # enabled/_wedged flags are GIL-atomic bool stores the writer
+        # flips on death/power-loss and the loop reads per hook.
+        self.enabled = False  # tpulint: shared=atomic
         self.path = ""
         self._seq = 0
         self._dirty: set[int] = set()
-        self._queue: list = []
+        self._queue: list = []  # tpulint: shared=lock
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
         self._thread: Optional[threading.Thread] = None
         self._stopping = False
-        self._wedged = False  # chaos torn_write: died mid-append
+        self._wedged = False  # chaos torn_write: died mid-append  # tpulint: shared=atomic
         self._flushed_seq = 0  # last seq fsync'd to disk
         # Python-side ledgers; must match wal_records_total{kind} /
         # wal_replayed_total{kind} exactly.
@@ -249,6 +254,9 @@ class WriteAheadLog:
         """Assign a sequence number, enqueue for the writer, count. The
         ONLY I/O here is a list append under a lock — the framing,
         write and fsync all happen on the writer thread."""
+        # Affinity: append is the loop's half of the queue handoff —
+        # the writer must never append (it would journal its own work).
+        _affinity.expect("tick-loop")
         with self._lock:
             self._seq += 1
             rec.seq = self._seq
@@ -272,6 +280,7 @@ class WriteAheadLog:
         writer thread) keeps channel state single-writer."""
         if not self._dirty:
             return
+        _affinity.expect("tick-loop")
         from .channel import get_channel
         from .snapshot import pack_channel_state
 
@@ -382,6 +391,7 @@ class WriteAheadLog:
     # ---- the writer thread ----------------------------------------------
 
     def _writer_loop(self) -> None:
+        _affinity.enter("wal-writer")
         try:
             f = open(self.path, "ab")
         except OSError:

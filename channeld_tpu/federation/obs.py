@@ -200,9 +200,14 @@ class FleetObs:
         self.reset()
 
     def reset(self) -> None:
-        # gateway id -> (digest dict, stored monotonic time)
-        self.digests: dict[str, tuple[dict, float]] = {}
-        self._local_refreshed = 0.0
+        # gateway id -> (digest dict, stored monotonic time). Written
+        # from the trunk reader (store_peer: a peer's digest arrived)
+        # AND the ops HTTP thread (refresh_local via a stale /fleet):
+        # every write is one GIL-atomic whole-entry store (the inner
+        # digest is never mutated in place), and every reader snapshots
+        # with dict()/list() first (doc/concurrency.md).
+        self.digests: dict[str, tuple[dict, float]] = {}  # tpulint: shared=atomic
+        self._local_refreshed = 0.0  # tpulint: shared=atomic
 
     # ---- intake ----------------------------------------------------------
 

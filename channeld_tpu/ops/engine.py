@@ -67,9 +67,18 @@ class SpatialEngine:
         self._mesh = mesh
         self._sharding = sharding
         self._cell_bucket = cell_bucket
-        self._mesh_step = None
+        # shared=fence declarations (doc/concurrency.md#fences): engine
+        # state is written from the tick-loop (mutators; the unguarded
+        # step) AND the device-guard worker (the guarded step + the
+        # in-process rebuild). The loop BLOCKS on the worker inside
+        # run_step, so the only true concurrency is a watchdog-abandoned
+        # zombie worker unwedging late — which the generation fence
+        # makes safe: every engine-visible store re-checks the
+        # generation between staging and store (machine-checked by
+        # tpulint's fence-discipline rule).
+        self._mesh_step = None  # tpulint: shared=fence
         # Cells-plane shed diagnostics, refreshed each mesh tick.
-        self.last_overflow = 0
+        self.last_overflow = 0  # tpulint: shared=fence
         if mesh is not None:
             n_dev = int(mesh.devices.size)
             # Entity arrays shard evenly over every mesh axis.
@@ -93,8 +102,8 @@ class SpatialEngine:
         self._free = list(range(entity_capacity - 1, -1, -1))
         self._slot_of_entity: dict[int, int] = {}
         self._entity_of_slot = np.zeros(entity_capacity, np.uint32)
-        self._dirty_slots: set[int] = set()
-        self._seed_cells: dict[int, int] = {}  # slot -> forced prev cell
+        self._dirty_slots: set[int] = set()  # tpulint: shared=fence
+        self._seed_cells: dict[int, int] = {}  # slot -> forced prev cell  # tpulint: shared=fence
 
         self._q_kind = np.zeros(query_capacity, np.int32)
         self._q_center = np.zeros((query_capacity, 2), np.float32)
@@ -108,9 +117,9 @@ class SpatialEngine:
         # (one recompile then). The device copy updates by row scatter —
         # H2D is O(changed rows x C), never the whole table.
         self._q_spot_dist: Optional[np.ndarray] = None
-        self._d_spot_dist = None
-        self._spot_dirty_rows: set[int] = set()
-        self._queries_dirty = True
+        self._d_spot_dist = None  # tpulint: shared=fence
+        self._spot_dirty_rows: set[int] = set()  # tpulint: shared=fence
+        self._queries_dirty = True  # tpulint: shared=fence
 
         # Host staging for the sub table. The device's last-fan-out column
         # is authoritative after each tick (fanout_due advances it); the
@@ -124,8 +133,8 @@ class SpatialEngine:
         # Per-column dirty tracking: interval/active writes must never
         # drag the stale host `last` along (an interval-only change would
         # otherwise snap that sub's window start back arbitrarily far).
-        self._sub_dirty_slots: set[int] = set()  # interval + active columns
-        self._sub_last_dirty: set[int] = set()  # last-fan-out column
+        self._sub_dirty_slots: set[int] = set()  # interval+active cols  # tpulint: shared=fence
+        self._sub_last_dirty: set[int] = set()  # last-fan-out column  # tpulint: shared=fence
 
         # Device state (entity arrays sharded over the mesh when given).
         # .copy(): jax's H2D transfer is async and may read the numpy
@@ -141,19 +150,19 @@ class SpatialEngine:
                 np.full(entity_capacity, -1, np.int32), self._entity_ns
             )
         else:
-            self._d_positions = jnp.asarray(self._positions.copy())
-            self._d_valid = jnp.asarray(self._valid.copy())
-            self._d_cell = jnp.full(entity_capacity, -1, jnp.int32)
-        self._d_queries: Optional[QuerySet] = None
-        self._d_sub_state = None
+            self._d_positions = jnp.asarray(self._positions.copy())  # tpulint: shared=fence
+            self._d_valid = jnp.asarray(self._valid.copy())  # tpulint: shared=fence
+            self._d_cell = jnp.full(entity_capacity, -1, jnp.int32)  # tpulint: shared=fence
+        self._d_queries: Optional[QuerySet] = None  # tpulint: shared=fence
+        self._d_sub_state = None  # tpulint: shared=fence
 
         self._start = time.monotonic()
-        self.last_result: Optional[dict] = None
+        self.last_result: Optional[dict] = None  # tpulint: shared=fence
         # Abandoned-step fence (core/device_guard.py): the watchdog bumps
         # this when it gives up on a hung step; a zombie worker thread
         # completing the old tick later must not commit its tail state
         # over a rebuilt engine (tick() re-checks before committing).
-        self.generation = 0
+        self.generation = 0  # tpulint: shared=fence
         # Serializes concurrent rebuild bodies (a watchdog-abandoned
         # rebuild's worker vs its retry on a fresh worker): the stale
         # one must never interleave transfers with — or commit over —

@@ -489,7 +489,7 @@ class TPUSpatialController(StaticGrid2DSpatialController):
             # an exception into the channel tick.
             stall = _chaos.stall_s("device.dispatch_stall")
             if stall:
-                _time.sleep(stall)
+                _time.sleep(stall)  # tpulint: disable=async-blocking -- chaos-injected dispatch stall MODELS a busy chip stalling the tick (doc/chaos.md); blocking is the point
         if _guard.enabled:
             # Supervised step (doc/device_recovery.md): watchdog +
             # transient retry + sentinel + in-process rebuild. None =

@@ -131,6 +131,14 @@ def main(argv: list[str] | None = None) -> int:
     report = run_analysis(repo, rules, baseline)
 
     if args.json:
+        # Per-domain stats from the thread model (doc/concurrency.md):
+        # reachable-function counts per execution domain plus the
+        # thread/executor entry-point census — what CI and
+        # check_artifacts gate on (a domain whose count collapses to 0
+        # means the model rotted even if no rule fired).
+        from channeld_tpu.analysis import threadmodel
+
+        model = threadmodel.build_model(repo)
         print(json.dumps({
             "findings": [
                 {"rule": f.rule, "path": f.path, "line": f.line,
@@ -140,6 +148,12 @@ def main(argv: list[str] | None = None) -> int:
             "suppressed": len(report.suppressed),
             "stale_baseline": report.stale_baseline,
             "unreasoned_baseline": report.unreasoned_baseline,
+            "domains": model.stats(),
+            "thread_entries": [
+                {"kind": s.kind, "path": s.rel, "line": s.line,
+                 "target": s.target_repr, "declared": s.declared}
+                for s in model.sites
+            ],
             "ok": report.ok,
         }, indent=2))
     else:

@@ -428,9 +428,39 @@ def check_artifact_metrics(repo: str = REPO) -> list[str]:
     return errors
 
 
+def check_concurrency_doc(repo: str = REPO) -> list[str]:
+    """doc/concurrency.md must document exactly the execution domains
+    the thread model declares (analysis/threadmodel.py DOMAINS) — the
+    doc is the operator's map of the threading discipline, and a
+    domain added without documentation (or documented after removal)
+    is drift. Gate input: the same per-domain table scripts/analyze.py
+    --json exports as ``domains``."""
+    from channeld_tpu.analysis.threadmodel import DOMAINS
+
+    path = os.path.join(repo, "doc", "concurrency.md")
+    if not os.path.exists(path):
+        return ["doc/concurrency.md missing (execution-domain reference "
+                "for analysis/threadmodel.py)"]
+    text = open(path).read()
+    errors: list[str] = []
+    documented = set(re.findall(r"^###\s+`([a-z-]+)`", text, re.M))
+    declared = {d.name for d in DOMAINS}
+    for name in sorted(declared - documented):
+        errors.append(
+            f"doc/concurrency.md: domain {name!r} is declared in "
+            "analysis/threadmodel.py but has no '### `<domain>`' section"
+        )
+    for name in sorted(documented - declared):
+        errors.append(
+            f"doc/concurrency.md: section for domain {name!r} has no "
+            "matching declaration in analysis/threadmodel.py DOMAINS"
+        )
+    return errors
+
+
 def main() -> int:
     errors = (check_artifacts() + check_doc_metrics()
-              + check_artifact_metrics())
+              + check_artifact_metrics() + check_concurrency_doc())
     if errors:
         for e in errors:
             print(f"DRIFT: {e}")

@@ -56,17 +56,28 @@ def _fresh_globals(tmp_path):
     stays enabled (it is always-on in production too) but dumps under
     the test's tmp dir and starts each test with empty rings — anomaly
     auto-dumps from one test must not land in the repo's profiles/ or
-    slow a later timing-sensitive test with a full-ring freeze."""
+    slow a later timing-sensitive test with a full-ring freeze.
+
+    Runtime thread-affinity assertions (core/affinity.py,
+    doc/concurrency.md) are ARMED for every tier-1 test: any code that
+    runs on the wrong thread relative to the declared thread model
+    records a violation, and the teardown below fails the offending
+    test with it. Off in production by default (-debug-affinity arms a
+    live gateway)."""
     from channeld_tpu.core import device_guard, events, overload, settings, tracing
+    from channeld_tpu.core.affinity import affinity
     from channeld_tpu.spatial import balancer as balancer_mod
 
     tracing.recorder.configure(dump_path=str(tmp_path))
+    affinity.arm(strict=False)
     yield
     from channeld_tpu.core import opshttp as opshttp_mod
     from channeld_tpu.core import slo as slo_mod
     from channeld_tpu.core import wal as wal_mod
     from channeld_tpu.federation import obs as obs_mod
 
+    violations = list(affinity.violations)
+    affinity.disarm()
     events.reset_all()
     settings.reset_global_settings()
     overload.reset_overload()
@@ -79,3 +90,7 @@ def _fresh_globals(tmp_path):
     slo_mod.reset_slo()
     obs_mod.reset_fleet_obs()
     opshttp_mod.reset_ops()
+    assert not violations, (
+        "runtime thread-affinity violations (doc/concurrency.md): "
+        f"{violations}"
+    )

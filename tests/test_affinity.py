@@ -1,0 +1,290 @@
+"""Runtime thread-affinity checker (core/affinity.py) — the thread
+model's runtime twin (doc/concurrency.md):
+
+- the static (analysis/threadmodel.py) and runtime (core/affinity.py)
+  domain tables agree, so the two enforcement layers cannot drift;
+- checker mechanics: enter/expect binding, violation recording with
+  the offending call site, strict raising, disarmed no-op cost;
+- the REAL planes run clean under the armed checker: a live WAL writer
+  fsyncing appends and a guarded device step on the worker pool both
+  bind their domains and produce zero violations (tier-1 runs EVERY
+  test this way via conftest);
+- a deliberate off-thread call is caught with the right domain;
+- regression coverage for the audit fixes the concurrency rules drove:
+  slo.status() and the /readyz trunk probe take snapshot reads that
+  survive concurrent loop-side mutation.
+"""
+
+import threading
+import time
+
+import pytest
+
+from channeld_tpu.analysis.threadmodel import DOMAINS
+from channeld_tpu.core.affinity import (
+    AffinityViolation,
+    DOMAIN_THREADS,
+    affinity,
+)
+from channeld_tpu.core.settings import global_settings
+
+from helpers import fresh_runtime
+
+
+@pytest.fixture(autouse=True)
+def runtime():
+    gch = fresh_runtime()
+    global_settings.development = True
+    yield gch
+
+
+def test_static_and_runtime_domain_tables_agree():
+    """One domain vocabulary on both sides: every declared static
+    domain has a runtime thread key, loop domains collapse onto the
+    loop thread, own-thread domains key on themselves."""
+    assert set(DOMAIN_THREADS) == {d.name for d in DOMAINS}
+    for d in DOMAINS:
+        expected = "loop" if d.thread == "loop" else d.name
+        assert DOMAIN_THREADS[d.name] == expected, d.name
+
+
+def test_enter_binds_and_expect_passes_on_the_same_thread():
+    affinity.arm()
+    affinity.enter("tick-loop")
+    affinity.expect("tick-loop")
+    affinity.expect("trunk-reader")  # same loop thread key
+    assert affinity.violations == []
+
+
+def test_expect_autobinds_when_unbound():
+    affinity.arm()
+    affinity.expect("wal-writer")  # observes reality, no violation
+    assert affinity.violations == []
+    assert affinity.report()["bound"]["wal-writer"] == \
+        threading.get_ident()
+
+
+def test_off_thread_expect_records_violation_with_site():
+    affinity.arm()
+    affinity.enter("tick-loop")
+    seen = []
+
+    def _wrong_thread():
+        affinity.expect("tick-loop")
+        seen.append(list(affinity.violations))
+
+    t = threading.Thread(target=_wrong_thread, name="intruder")
+    t.start()
+    t.join()
+    assert len(seen[0]) == 1
+    v = seen[0][0]
+    assert v["domain"] == "tick-loop"
+    assert v["actual"] == "intruder"
+    assert "test_affinity.py" in v["where"]
+    # Clear the deliberate violation so the conftest gate stays green.
+    affinity.arm()
+
+
+def test_strict_mode_raises():
+    affinity.arm(strict=True)
+    affinity.enter("device-worker")
+    err = []
+
+    def _wrong_thread():
+        try:
+            affinity.expect("device-worker")
+        except AffinityViolation as e:
+            err.append(e)
+
+    t = threading.Thread(target=_wrong_thread)
+    t.start()
+    t.join()
+    assert err
+    affinity.arm()  # drop strictness + the recorded violation
+
+
+def test_disarmed_hooks_are_noops():
+    affinity.disarm()
+    affinity.enter("tick-loop")
+    affinity.expect("wal-writer")
+    assert affinity.report()["bound"] == {}
+    affinity.arm()  # restore the conftest-armed state
+
+
+def test_reentry_rebinds_for_a_fresh_thread():
+    """A new writer thread (fresh test, fresh event loop) takes the
+    binding over via enter() instead of tripping the old one."""
+    affinity.arm()
+    results = []
+
+    def _writer(tag):
+        affinity.enter("wal-writer")
+        affinity.expect("wal-writer")
+        results.append(tag)
+
+    for tag in ("first", "second"):
+        t = threading.Thread(target=_writer, args=(tag,))
+        t.start()
+        t.join()
+    assert results == ["first", "second"]
+    assert affinity.violations == []
+
+
+# ---------------------------------------------------------------------------
+# the real planes under the armed checker
+# ---------------------------------------------------------------------------
+
+
+def test_live_wal_writer_runs_clean_under_armed_checker(tmp_path):
+    """A REAL journal: loop-side appends + flush barrier, writer-thread
+    framing/fsync — every hook armed, zero violations, and the writer
+    thread visibly bound its domain."""
+    from channeld_tpu.core.wal import wal
+    from channeld_tpu.protocol import wal_pb2
+
+    affinity.arm()
+    wal.start(str(tmp_path / "test.wal"))
+    try:
+        for cid in range(8):
+            wal.append("channel_removed", wal_pb2.WalRecord(channelId=cid))
+        assert wal.flush(timeout_s=5.0)
+    finally:
+        wal.stop()
+    assert affinity.violations == []
+    bound = affinity.report()["bound"]
+    assert "wal-writer" in bound
+    assert bound["wal-writer"] != threading.get_ident()
+
+
+def test_guarded_device_step_runs_clean_under_armed_checker():
+    """A REAL guarded engine step: run_step asserts the loop thread,
+    the worker body binds device-worker on the pool thread — zero
+    violations, and the step serves a result."""
+    from channeld_tpu.core.device_guard import guard
+    from channeld_tpu.ops.engine import SpatialEngine
+    from channeld_tpu.ops.spatial_ops import GridSpec
+
+    affinity.arm()
+    affinity.enter("tick-loop")
+
+    class _Ctl:
+        engine = SpatialEngine(
+            GridSpec(offset_x=0.0, offset_z=0.0, cell_w=50.0,
+                     cell_h=50.0, cols=2, rows=1),
+            entity_capacity=16, query_capacity=4, sub_capacity=16,
+            max_handovers=8,
+        )
+
+    ctl = _Ctl()
+    ctl.engine.add_entity(1, 10.0, 0.0, 10.0)
+    result = guard.run_step(ctl)
+    assert result is not None
+    assert affinity.violations == []
+    bound = affinity.report()["bound"]
+    assert "device-worker" in bound
+    assert bound["device-worker"] != threading.get_ident()
+
+
+def test_ops_handler_binds_its_domain_over_live_http():
+    """A real /healthz probe: the handler thread enters ops-http; the
+    loop binding is untouched and no violations record."""
+    import json
+    import urllib.request
+
+    from channeld_tpu.core.opshttp import reset_ops, serve_ops
+
+    affinity.arm()
+    affinity.enter("tick-loop")
+    srv = serve_ops(0, host="127.0.0.1")
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/healthz", timeout=5) as r:
+            doc = json.loads(r.read())
+        assert doc["ok"] is True
+    finally:
+        reset_ops()
+    assert affinity.violations == []
+    bound = affinity.report()["bound"]
+    assert "ops-http" in bound
+    assert bound["ops-http"] != threading.get_ident()
+
+
+# ---------------------------------------------------------------------------
+# regression: the snapshot-read fixes the concurrency audit drove
+# ---------------------------------------------------------------------------
+
+
+def test_slo_status_survives_concurrent_reconfigure():
+    """slo.status() reads the SLO table from the ops thread; the fixed
+    list() snapshot must survive a loop-side configure() storm without
+    dict-changed-size errors (the pre-fix failure mode)."""
+    from channeld_tpu.core.slo import slo
+
+    slo.configure(enabled=True)
+    stop = threading.Event()
+    errors = []
+
+    def _hammer():
+        try:
+            while not stop.is_set():
+                slo.configure(enabled=True)
+        except Exception as e:  # pragma: no cover - the regression
+            errors.append(e)
+
+    t = threading.Thread(target=_hammer)
+    t.start()
+    try:
+        deadline = time.monotonic() + 0.5
+        while time.monotonic() < deadline:
+            slo.status()  # must never raise mid-swap
+    finally:
+        stop.set()
+        t.join()
+    assert errors == []
+
+
+def test_trunk_probe_survives_concurrent_link_churn(monkeypatch):
+    """/readyz's trunk probe iterates the link table from the ops
+    thread; the fixed list() snapshot must survive loop-side link
+    install/drop churn (the pre-fix generator raised RuntimeError:
+    dictionary changed size during iteration)."""
+    from channeld_tpu.core import opshttp
+    from channeld_tpu.federation import plane as plane_mod
+    from channeld_tpu.federation.directory import directory
+
+    class _Link:
+        alive = True
+
+    class _Mgr:
+        links = {}
+
+    monkeypatch.setattr(directory, "_config", object(), raising=False)
+    monkeypatch.setattr(directory, "local_id", "a", raising=False)
+    monkeypatch.setattr(
+        type(directory), "active",
+        property(lambda self: True), raising=False)
+    monkeypatch.setattr(
+        directory, "peers", lambda: ["b", "c"], raising=False)
+    monkeypatch.setattr(plane_mod, "manager", _Mgr(), raising=False)
+
+    stop = threading.Event()
+    errors = []
+
+    def _churn():
+        i = 0
+        while not stop.is_set():
+            _Mgr.links[f"peer{i % 17}"] = _Link()
+            _Mgr.links.pop(f"peer{(i + 9) % 17}", None)
+            i += 1
+
+    t = threading.Thread(target=_churn)
+    t.start()
+    try:
+        deadline = time.monotonic() + 0.5
+        while time.monotonic() < deadline:
+            ok, detail = opshttp._trunk_ready()
+            assert isinstance(detail, str)
+    finally:
+        stop.set()
+        t.join()
+    assert errors == []

@@ -830,3 +830,272 @@ def test_changed_mode_metrics_edit_keeps_cross_file_findings(
     assert any(f["rule"] == "double-entry"
                and f["path"] == "channeld_tpu/core/user.py"
                for f in out["findings"])
+
+
+# ---------------------------------------------------------------------------
+# rule fixtures: the concurrency suite (doc/concurrency.md)
+# ---------------------------------------------------------------------------
+
+from channeld_tpu.analysis.rules.affinity import (  # noqa: E402
+    FenceDisciplineRule,
+    LiveIterRule,
+    OffLoopAsyncioRule,
+    SharedStateRule,
+    ThreadModelRule,
+)
+
+WAL_REL = "channeld_tpu/core/wal.py"
+ENGINE_REL = "channeld_tpu/ops/engine.py"
+GUARD_REL = "channeld_tpu/core/device_guard.py"
+OPS_REL = "channeld_tpu/core/opshttp.py"
+
+
+def test_rule_registry_names_the_concurrency_suite():
+    names = {r.name for r in make_rules()}
+    assert {"thread-model", "shared-state", "off-loop-asyncio",
+            "fence-discipline", "live-iter"} <= names
+
+
+def test_thread_model_flags_undeclared_thread_entry():
+    m = mod("channeld_tpu/core/pump.py", (
+        "import threading\n"
+        "def _mystery_worker():\n"
+        "    pass\n"
+        "def start():\n"
+        "    threading.Thread(target=_mystery_worker).start()\n"
+    ))
+    findings = [f for f in ThreadModelRule().check_repo(ctx(m))
+                if f.detector.startswith("undeclared-entry")]
+    assert len(findings) == 1
+    assert findings[0].path == "channeld_tpu/core/pump.py"
+    assert "_mystery_worker" in findings[0].detector
+
+
+def test_thread_model_quiet_on_declared_entries_and_offload():
+    m = mod(WAL_REL, (
+        "import asyncio, threading\n"
+        "class WriteAheadLog:\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self._writer_loop).start()\n"
+        "    def _writer_loop(self):\n"
+        "        pass\n"
+        "async def save():\n"
+        "    await asyncio.to_thread(_write_blob)\n"
+        "def _write_blob():\n"
+        "    pass\n"
+    ))
+    findings = [f for f in ThreadModelRule().check_repo(ctx(m))
+                if f.detector.startswith("undeclared-entry")]
+    assert findings == []
+
+
+def test_thread_model_reports_stale_spec_seed():
+    # A core/wal.py module WITHOUT _writer_loop: the declared
+    # wal-writer seed matches nothing -> the model is rotting.
+    m = mod(WAL_REL, "class WriteAheadLog:\n    pass\n")
+    findings = ThreadModelRule().check_repo(ctx(m))
+    assert any(f.detector.startswith("stale-seed:wal-writer")
+               for f in findings)
+
+
+_SHARED_FIXTURE = (
+    "class WriteAheadLog:\n"
+    "    def __init__(self):\n"
+    "        self.q = []{decl}\n"
+    "    def _writer_loop(self):\n"          # wal-writer domain (seed)
+    "        self.q = []\n"
+    "    async def pump(self):\n"            # tick-loop domain (default)
+    "        self.q.append(1)\n"
+)
+
+
+def test_shared_state_flags_undeclared_cross_domain_write():
+    m = mod(WAL_REL, _SHARED_FIXTURE.format(decl=""))
+    findings = SharedStateRule().check_module(m, ctx(m))
+    assert [f.detector for f in findings] == ["cross-domain-write"]
+    assert findings[0].scope == "WriteAheadLog.q"
+
+
+def test_shared_state_quiet_with_declared_mechanism():
+    m = mod(WAL_REL, _SHARED_FIXTURE.format(
+        decl="  # tpulint: shared=lock"))
+    assert SharedStateRule().check_module(m, ctx(m)) == []
+
+
+def test_shared_state_flags_unknown_mechanism():
+    m = mod(WAL_REL, _SHARED_FIXTURE.format(
+        decl="  # tpulint: shared=vibes"))
+    found = {f.detector for f in SharedStateRule().check_module(m, ctx(m))}
+    # The bogus declaration is a finding AND does not satisfy the
+    # cross-domain requirement.
+    assert found == {"bad-shared-declaration", "cross-domain-write"}
+
+
+def test_shared_state_quiet_on_single_domain_writes():
+    m = mod(WAL_REL, (
+        "class WriteAheadLog:\n"
+        "    def _writer_loop(self):\n"
+        "        self.flushed = 0\n"
+        "        self.flushed += 1\n"
+    ))
+    assert SharedStateRule().check_module(m, ctx(m)) == []
+
+
+def test_off_loop_asyncio_flags_call_soon_from_writer_thread():
+    m = mod(WAL_REL, (
+        "class WriteAheadLog:\n"
+        "    def _writer_loop(self):\n"
+        "        self.loop.call_soon(self._cb)\n"
+    ))
+    findings = OffLoopAsyncioRule().check_module(m, ctx(m))
+    assert [f.detector for f in findings] == ["call_soon"]
+    assert "wal-writer" in findings[0].message
+
+
+def test_off_loop_asyncio_quiet_on_threadsafe_variant_and_loop_code():
+    m = mod(WAL_REL, (
+        "import asyncio\n"
+        "class WriteAheadLog:\n"
+        "    def _writer_loop(self):\n"
+        "        self.loop.call_soon_threadsafe(self._cb)\n"
+        "    async def on_tick(self):\n"
+        "        asyncio.get_running_loop().create_task(self._coro())\n"
+    ))
+    assert OffLoopAsyncioRule().check_module(m, ctx(m)) == []
+
+
+def _fence_ctx(engine_body: str):
+    guard = mod(GUARD_REL, (
+        "class DeviceGuard:\n"
+        "    @staticmethod\n"
+        "    def _step_body(engine, gen):\n"
+        "        return engine.tick()\n"
+    ))
+    engine = mod(ENGINE_REL, engine_body)
+    return engine, ctx(guard, engine)
+
+
+def test_fence_discipline_flags_unfenced_device_store():
+    engine, repo = _fence_ctx(
+        "class SpatialEngine:\n"
+        "    def tick(self):\n"
+        "        out = self._compute()\n"
+        "        self._d_cell = out\n"       # no fence between call+store
+        "        return out\n"
+    )
+    findings = FenceDisciplineRule().check_module(engine, repo)
+    assert [f.detector for f in findings] == ["unfenced-store:_d_cell"]
+    assert findings[0].scope == "SpatialEngine.tick"
+
+
+def test_fence_discipline_quiet_on_fenced_stores():
+    engine, repo = _fence_ctx(
+        "class SpatialEngine:\n"
+        "    def tick(self):\n"
+        "        gen = self.generation\n"
+        "        out = self._compute()\n"
+        "        if gen != self.generation:\n"
+        "            raise RuntimeError('stale')\n"
+        "        self._d_cell = out\n"
+        "        self._d_sub_state = out\n"  # fence covers the block
+        "        self._dirty.clear()\n"      # clear() keeps the fence
+        "        return out\n"
+        "    def _flush(self):\n"
+        "        staged = self._stage()\n"
+        "        self._fence()\n"
+        "        self._d_positions = staged\n"
+    )
+    assert FenceDisciplineRule().check_module(engine, repo) == []
+
+
+def test_fence_discipline_ignores_loop_only_functions():
+    # A store outside the device-worker reachable set (plain setup
+    # code) is the loop's business, not the fence rule's.
+    engine, repo = _fence_ctx(
+        "class SpatialEngine:\n"
+        "    def tick(self):\n"
+        "        self._fence()\n"
+        "        return 1\n"
+        "    def setup(self):\n"
+        "        self._d_cell = self._alloc()\n"
+    )
+    assert FenceDisciplineRule().check_module(engine, repo) == []
+
+
+def test_live_iter_flags_off_loop_view_iteration():
+    m = mod(OPS_REL, (
+        "class _OpsHandler:\n"
+        "    def do_GET(self):\n"
+        "        return [k for k, v in self.registry.items()]\n"
+    ))
+    findings = LiveIterRule().check_module(m, ctx(m))
+    assert [f.detector for f in findings] == [
+        "live-iter:self.registry.items"]
+
+
+def test_live_iter_quiet_on_snapshot_and_locked_iteration():
+    m = mod(OPS_REL, (
+        "class _OpsHandler:\n"
+        "    def do_GET(self):\n"
+        "        snap = list(self.registry.items())\n"   # C-level copy
+        "        a = [k for k, v in snap]\n"
+        "        with self._rings_lock:\n"               # held lock
+        "            b = [k for k in self.rings.values()]\n"
+        "        return a + b\n"
+    ))
+    assert LiveIterRule().check_module(m, ctx(m)) == []
+
+
+def test_async_blocking_reaches_sync_helpers_via_call_graph():
+    m = mod(TRUNK_REL, (
+        "import time\n"
+        "async def pump(self):\n"
+        "    _drain()\n"
+        "def _drain():\n"
+        "    time.sleep(1)\n"                # 3 calls deep is the same bug
+    ))
+    findings = AsyncBlockingRule().check_module(m, ctx(m))
+    assert [(f.scope, f.detector) for f in findings] == [
+        ("_drain", "time.sleep")]
+    assert "reachable from the tick-loop" in findings[0].message
+
+
+def test_async_blocking_exempts_boot_loop_domain():
+    m = mod("channeld_tpu/core/server.py", (
+        "async def run_server():\n"
+        "    _restore()\n"
+        "def _restore():\n"
+        "    open('/tmp/snap')\n"            # boot blocks legitimately
+    ))
+    assert AsyncBlockingRule().check_module(m, ctx(m)) == []
+
+
+def test_async_blocking_flags_unbounded_result_wait():
+    m = mod(TRUNK_REL, (
+        "async def pump(self):\n"
+        "    _collect(self.fut)\n"
+        "def _collect(fut):\n"                # sync, loop-reachable
+        "    bad = fut.result()\n"
+        "    ok = fut.result(timeout=1.0)\n"
+        "async def gather(self, done):\n"
+        "    return [t.result() for t in done]\n"  # asyncio Task: quiet
+    ))
+    findings = AsyncBlockingRule().check_module(m, ctx(m))
+    assert [(f.scope, f.detector) for f in findings] == [
+        ("_collect", "result-no-timeout")]
+
+
+def test_fence_discipline_flags_conditionally_fenced_store():
+    """A fence inside ONE branch must not license the store after the
+    compound statement — the path that skipped the branch commits with
+    no generation re-check (the exact zombie-worker hole)."""
+    engine, repo = _fence_ctx(
+        "class SpatialEngine:\n"
+        "    def tick(self):\n"
+        "        staged = self._stage()\n"
+        "        if self.fast_path:\n"
+        "            self._fence()\n"
+        "        self._d_cell = staged\n"
+    )
+    findings = FenceDisciplineRule().check_module(engine, repo)
+    assert [f.detector for f in findings] == ["unfenced-store:_d_cell"]

@@ -357,3 +357,28 @@ def test_artifact_quoted_exposition_ref_is_validated(tmp_path):
     errors = check_artifacts.check_artifact_metrics(str(tmp_path))
     assert any("fanout_decision_latency_seconds" in e and "['chip']" in e
                for e in errors)
+
+
+def test_concurrency_doc_matches_thread_model():
+    """doc/concurrency.md documents exactly the execution domains
+    analysis/threadmodel.py declares (doc/concurrency.md is the
+    operator's map; drift in either direction fails)."""
+    assert check_artifacts.check_concurrency_doc() == []
+
+
+def test_concurrency_doc_drift_is_flagged(tmp_path):
+    doc_dir = tmp_path / "doc"
+    doc_dir.mkdir()
+    (doc_dir / "concurrency.md").write_text(
+        "# x\n\n### `tick-loop`\n\n### `ghost-domain`\n"
+    )
+    errors = check_artifacts.check_concurrency_doc(str(tmp_path))
+    # Every undocumented declared domain + the phantom section flag.
+    assert any("ghost-domain" in e for e in errors)
+    assert any("wal-writer" in e for e in errors)
+
+
+def test_missing_concurrency_doc_is_flagged(tmp_path):
+    (tmp_path / "doc").mkdir()
+    errors = check_artifacts.check_concurrency_doc(str(tmp_path))
+    assert errors and "missing" in errors[0]
