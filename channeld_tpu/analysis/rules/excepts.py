@@ -59,11 +59,30 @@ SCOPE: tuple[tuple[str, str], ...] = (
      r"_wal_ready|_trunk_ready)$"),
     ("channeld_tpu/federation/obs.py",
      r"^(attach_digest|store_peer|refresh_local|merged|render_)"),
+    # Adversarial edge plane (PR 16, doc/edge_hardening.md): the receive
+    # path and the edge ladder run uncaught on the event loop — a
+    # swallowed failure here is precisely the "parse failure becomes
+    # gateway-fatal" defect class the wire fuzzer hunts, so every broad
+    # except must stay connection-fatal AND on the record.
+    ("channeld_tpu/core/edge.py",
+     r"^(note_egress|note_drain|note_frames|edge_tick|quarantine|"
+     r"_trim_to_watermark|_structured_disconnect|mark_full_resync)$"),
+    ("channeld_tpu/core/connection.py",
+     r"^(on_bytes|receive_message|flush|flush_ingest|flush_pending)$"),
+    ("channeld_tpu/core/ddos.py", r"^check_unauth_conns_once$"),
+    # The fuzz harness's catches ARE its oracle: each one must file a
+    # Violation (traceback.format_exc on the record) or log warning+.
+    ("channeld_tpu/chaos/fuzz.py", r"^(_feed|_pump_sync|run_case)$"),
 )
 
 _LOG_OK = {"warning", "error", "exception", "critical"}
 _ACCOUNT_CALLS = {"_count", "_note", "_event", "count_shed", "append_event",
-                  "span", "event", "stage"}
+                  "span", "event", "stage",
+                  # Edge-plane double-entry ledgers (core/edge.py) and the
+                  # fuzzer's violation record (the captured traceback IS
+                  # the trace).
+                  "count_quarantine", "count_malformed", "count_egress_drop",
+                  "count_reap", "format_exc"}
 
 
 def _absolved(handler: ast.ExceptHandler) -> bool:

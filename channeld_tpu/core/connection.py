@@ -27,6 +27,7 @@ from ..protocol.framing import FrameDecoder, HEADER_SIZE, MAX_PACKET_SIZE
 from ..protocol import snappy as snappy_codec
 from ..utils.idalloc import hash_string
 from ..utils.logger import get_logger
+from . import edge as _edge
 from . import events, metrics
 from .fsm import MessageFsm
 from .tracing import recorder as _trace
@@ -141,6 +142,12 @@ class QueuedMessagePackSender:
             )
             return
         if not conn.is_closing():
+            env = conn.envelope
+            if env.quarantined:
+                # Egress frozen: the peer gets nothing but the final
+                # structured disconnect (counted, never silent).
+                _edge.ledgers.count_egress_drop("quarantine")
+                return
             # No int() casts: enum values are int subclasses and both
             # packet encoders take them as-is.
             conn.send_queue.append(
@@ -148,6 +155,9 @@ class QueuedMessagePackSender:
                  ctx.msg_type, body)
             )
             _pending_flush.add(conn)
+            if global_settings.edge_enabled:
+                env.queue_bytes += len(body) + _edge.ENTRY_OVERHEAD
+                _edge.note_egress(conn)
 
 
 class Connection:
@@ -180,6 +190,10 @@ class Connection:
         self.spatial_subscriptions: dict[int, object] = {}
         self.recover_handle = None
         self.logger = get_logger(f"conn.{self.connection_type.name}.{conn_id}")
+        # Per-connection edge-plane state: egress occupancy, the
+        # slow-consumer ladder position, the ingress token bucket
+        # (core/edge.py; doc/edge_hardening.md).
+        self.envelope = _edge.ConnectionEnvelope()
         # Per-connection labels never change; resolving the labelled
         # children once keeps prometheus' .labels() tuple-building and
         # validation out of the per-packet hot path (~8% of active CPU
@@ -217,10 +231,16 @@ class Connection:
     def on_bytes(self, data: bytes) -> None:
         """Feed raw stream bytes; dispatches every complete packet.
         Fatal framing/parse errors close the connection (ref: readPacket)."""
+        if self.envelope.quarantined:
+            # Quarantine discards ingress outright: the peer already
+            # earned its structured disconnect, and parsing its bytes
+            # would keep paying for an abuser (doc/edge_hardening.md).
+            return
         try:
             bodies = self.decoder.feed(data)
         except Exception as e:  # framing violations are connection-fatal
             self.logger.warning("bad inbound frame, closing connection: %s", e)
+            _edge.ledgers.count_malformed("framing")
             metrics.connection_closed.labels(
                 conn_type=self.connection_type.name
             ).inc()
@@ -237,6 +257,10 @@ class Connection:
             self.compression_type = CompressionType.SNAPPY
         if not bodies:
             return
+        if global_settings.edge_enabled and not _edge.note_frames(
+            self, len(bodies)
+        ):
+            return  # flood cap quarantined the peer; the read is discarded
         # One ingest stamp per read batch: the delivery-SLO mark every
         # message of this read carries (core/slo.py). monotonic_ns is
         # ~40ns; stamping per read (not per message) keeps the 10K-conn
@@ -343,6 +367,7 @@ class Connection:
             # transport layer closes with unexpected=True and recoverable
             # server conns stay eligible for recovery.
             self.logger.warning("bad inbound packet, closing connection: %s", e)
+            _edge.ledgers.count_malformed("packet")
             metrics.connection_closed.labels(
                 conn_type=self.connection_type.name
             ).inc()
@@ -469,6 +494,7 @@ class Connection:
         entry = MESSAGE_MAP.get(mp.msgType)
         if entry is None and mp.msgType < MessageType.USER_SPACE_START:
             self.logger.error("undefined message type %d", mp.msgType)
+            _edge.ledgers.count_malformed("message")
             return False
 
         if self.fsm is not None and not self.fsm.is_allowed(mp.msgType):
@@ -500,6 +526,7 @@ class Connection:
                     msg.ParseFromString(mp.msgBody)
                 except Exception:
                     self.logger.exception("unmarshalling ServerForwardMessage")
+                    _edge.ledgers.count_malformed("message")
                     return False
                 handler = handle_server_to_client_user_message
                 # Pure forward (no registered handler exists for this type,
@@ -515,6 +542,7 @@ class Connection:
                 msg.ParseFromString(mp.msgBody)
             except Exception:
                 self.logger.exception("unmarshalling message type %d", mp.msgType)
+                _edge.ledgers.count_malformed("message")
                 return False
             handler = entry.handler
 
@@ -558,13 +586,46 @@ class Connection:
             return
         self.sender.send(self, ctx)
 
-    def flush(self) -> None:
+    def flush(self, fair: bool = False) -> None:
         """Batch queued messages into <=64KB packets, compress, frame,
         write (ref: connection.go:626-714). The native codec builds the
-        protobuf wire bytes directly from the queued tuples."""
+        protobuf wire bytes directly from the queued tuples.
+
+        ``fair=True`` (the shared pump) caps one call at
+        edge_flush_fair_msgs entries so a single hot connection cannot
+        starve the 1ms cycle for every other peer; the remainder stays
+        queued and the pump re-schedules it next cycle. Direct callers
+        (disconnect, drain) flush everything."""
         if not self.send_queue:
             return
-        batch, self.send_queue = self.send_queue, []
+        env = self.envelope
+        if fair and global_settings.edge_enabled:
+            # Transport-backpressure gate (doc/edge_hardening.md): a peer
+            # that stops draining its socket must not hide in the
+            # transport's write buffer — leave the entries queued so the
+            # envelope (bounded, counted) absorbs them and the
+            # slow-consumer ladder sees the backlog. The pump re-queues
+            # this connection next cycle; direct flushes (disconnect,
+            # drain) bypass the gate.
+            gate = global_settings.edge_transport_high_bytes
+            if gate > 0:
+                getter = getattr(self.transport, "get_write_buffer_size", None)
+                if getter is not None and getter() > gate:
+                    return
+        limit = (global_settings.edge_flush_fair_msgs
+                 if fair and global_settings.edge_enabled else 0)
+        if limit and len(self.send_queue) > limit:
+            batch = self.send_queue[:limit]
+            del self.send_queue[:limit]
+            env.queue_bytes -= sum(
+                len(e[4]) for e in batch
+            ) + len(batch) * _edge.ENTRY_OVERHEAD
+            if env.queue_bytes < 0:
+                env.queue_bytes = 0
+        else:
+            batch, self.send_queue = self.send_queue, []
+            env.queue_bytes = 0
+        _edge.note_drain(self)
         ct = self.compression_type
         if ct == CompressionType.SNAPPY and not snappy_codec.available():
             ct = CompressionType.NO_COMPRESSION
@@ -689,6 +750,8 @@ class Connection:
         # Normally already flushed above; a run that re-appeared (close
         # handler fed bytes) dies with the conn.
         self._fast_run = None
+        self.envelope.queue_bytes = 0
+        _edge.forget(self)
         _pending_ingest.discard(self)
         _stash_retry.pop(self, None)
         _all_connections.pop(self.id, None)
@@ -919,6 +982,13 @@ def drain_pending_flush() -> set["Connection"]:
     return pending
 
 
+def requeue_flush(conn: "Connection") -> None:
+    """Put a connection back on the pump's pending set — the fairness
+    carry-over path: a fair flush left entries queued, and they must go
+    out next cycle without waiting for new sends."""
+    _pending_flush.add(conn)
+
+
 # Connections whose ingest dispatch stashed (queue full) from a pump- or
 # tick-time flush, where no transport drain task exists to retry: the
 # pump retries flush_pending until the stash drains (the transport-side
@@ -985,3 +1055,4 @@ def reset_connections() -> None:
     _stash_retry.clear()
     _reserved_conn_ids.clear()
     _next_connection_id = 0
+    _edge.reset_edge()

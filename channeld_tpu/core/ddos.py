@@ -77,7 +77,7 @@ def restore_blacklists(ips, pits) -> tuple[int, int]:
 
 
 def track_unauthenticated(conn) -> None:
-    if global_settings.connection_auth_timeout_ms > 0:
+    if global_settings.effective_auth_deadline_ms() > 0:
         _unauthenticated_connections[conn.id] = conn
 
 
@@ -135,12 +135,18 @@ def init_anti_ddos() -> None:
 
 
 def check_unauth_conns_once() -> None:
-    """Close + blacklist connections that never authenticated
-    (ref: ddos.go:66-82)."""
-    timeout_s = global_settings.connection_auth_timeout_ms / 1000.0
+    """Close + blacklist connections that never completed the FSM
+    handshake within the auth window (ref: ddos.go:66-82; -auth-deadline,
+    doc/edge_hardening.md). Each reap is double-entry counted
+    (conn_reaped_total{reason=auth_timeout} == the core/edge.py ledger).
+    Recovery-handle reconnects are exempt: a socket a live recovery
+    handle has claimed is mid-resume — reaping (and worse, IP-banning)
+    it would turn one transient disconnect into a permanent lockout."""
+    timeout_s = global_settings.effective_auth_deadline_ms() / 1000.0
     if timeout_s <= 0:
         return
     now = time.monotonic()
+    claimed = None  # built lazily: only a reap-candidate pays the scan
     for conn in list(_unauthenticated_connections.values()):
         if conn.is_closing():
             _unauthenticated_connections.pop(conn.id, None)
@@ -149,10 +155,22 @@ def check_unauth_conns_once() -> None:
             conn.state == ConnectionState.UNAUTHENTICATED
             and now - conn.conn_time >= timeout_s
         ):
+            if claimed is None:
+                from .connection_recovery import _recover_handles
+
+                claimed = {
+                    h.new_conn for h in _recover_handles.values()
+                    if h.new_conn is not None
+                }
+            if conn in claimed:
+                continue
             ip = conn.remote_ip()
             if ip is not None:
                 ban_ip(ip)
             conn.close()
+            from .edge import ledgers as _edge_ledgers
+
+            _edge_ledgers.count_reap("auth_timeout")
             security_logger().info(
                 "closed and blacklisted unauthenticated connection from %s", ip
             )

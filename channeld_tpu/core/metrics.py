@@ -423,6 +423,67 @@ overload_sheds = Counter(
     ["reason"],
     registry=registry,
 )
+# Adversarial edge plane (core/edge.py; doc/edge_hardening.md). Every
+# counter here is double-entry: the python ledger in core/edge.py
+# (EdgeLedgers) must match exactly, and the abuse soak asserts it on a
+# live gateway.
+conn_quarantine = Counter(
+    "conn_quarantine",
+    "Connections quarantined by the edge plane (slow_consumer: egress "
+    "held at the high watermark past the grace window even after "
+    "drop-to-full-resync; ingress_flood: sustained frame-rate cap "
+    "violations). Quarantine is per-peer and ends in a structured "
+    "disconnect; global load shedding stays with the overload ladder. "
+    "The python ledger in core/edge.py (quarantine_counts) must match "
+    "exactly",
+    ["reason"],
+    registry=registry,
+)
+malformed_frames = Counter(
+    "malformed_frames",
+    "Inbound wire violations, counted at the stage that rejected them "
+    "(framing: bad magic/length/compression tag at the frame decoder; "
+    "packet: frame body failed protobuf Packet parse; message: a "
+    "MessagePack body failed its template parse or hit an undefined "
+    "type). Each is connection-fatal at worst, never gateway-fatal. "
+    "The python ledger in core/edge.py (malformed_counts) must match "
+    "exactly",
+    ["stage"],
+    registry=registry,
+)
+egress_dropped = Counter(
+    "egress_dropped",
+    "Send-queue entries dropped by the per-connection egress envelope "
+    "(queue_msgs: entry cap hit; queue_bytes: byte cap hit; "
+    "slow_consumer: queue cleared by the drop-to-full-resync step of "
+    "the slow-consumer ladder; quarantine: queue discarded at "
+    "quarantine entry). Every cap/ladder drop marks the connection "
+    "for full-state resync on its SHED-eligible subscriptions, so a "
+    "bounded queue degrades to a coarser cadence, never to silent "
+    "state loss. The python ledger in core/edge.py "
+    "(egress_drop_counts) must match exactly",
+    ["reason"],
+    registry=registry,
+)
+conn_reaped = Counter(
+    "conn_reaped",
+    "Sockets reaped by edge deadlines (auth_timeout: never completed "
+    "the FSM handshake within the auth window — recovery-handle "
+    "reconnects exempt; quarantine: the quarantine grace expired and "
+    "the peer was disconnected; send_buffer: the MAX_SEND_BUFFER "
+    "backstop aborted a peer whose transport backlog outran even the "
+    "flush gate). The python ledger in core/edge.py (reap_counts) "
+    "must match exactly",
+    ["reason"],
+    registry=registry,
+)
+conn_quarantined_num = Gauge(
+    "conn_quarantined_num",
+    "Connections currently in quarantine (egress frozen, awaiting the "
+    "structured disconnect deadline)",
+    registry=registry,
+)
+
 follower_interest_ms = Histogram(
     "follower_interest_ms",
     "Host cost of one _apply_follow_interests pass, milliseconds "

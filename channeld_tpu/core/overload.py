@@ -196,6 +196,7 @@ class OverloadGovernor:
         # settings, not us, so there is no cycle at module load).
         from . import channel as channel_mod
         from . import connection as connection_mod
+        from . import edge as edge_mod
 
         st = global_settings
         stash_conns = len(connection_mod._stash_retry)
@@ -219,6 +220,11 @@ class OverloadGovernor:
             "handover": self._handover_cost_s / interval,
             # Host cost of applying follower interests, same scale.
             "follower": self._follower_cost_s / interval,
+            # Edge-plane distress population (slow-consumer suspects +
+            # quarantined peers): each peer is handled per-peer by
+            # core/edge.py, but a FLEET of them is gateway saturation
+            # and must move the global ladder too.
+            "edge": edge_mod.pressure(),
         }
         self.components = comps
         self.components["stash_msgs"] = float(stash_msgs)
@@ -227,7 +233,7 @@ class OverloadGovernor:
         self._follower_cost_s = 0.0
 
         raw = max(comps["tick_util"], comps["backlog"],
-                  comps["handover"], comps["follower"])
+                  comps["handover"], comps["follower"], comps["edge"])
         alpha = st.overload_alpha
         self.pressure = alpha * raw + (1.0 - alpha) * self.pressure
 

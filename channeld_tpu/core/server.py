@@ -29,7 +29,10 @@ from .connection import (
     drain_pending_flush,
     flush_pending_ingest,
     init_connections,
+    requeue_flush,
 )
+from . import edge as _edge
+from .edge import edge_tick
 from .connection_recovery import connection_recovery_loop
 from .ddos import init_anti_ddos, unauth_reaper_loop
 from .settings import global_settings
@@ -65,11 +68,24 @@ class TcpTransport:
         except (AttributeError, NotImplementedError):
             buffered = 0
         if buffered + len(data) > MAX_SEND_BUFFER:
+            # Backstop behind the edge plane's transport gate
+            # (edge_transport_high_bytes normally defers the pump well
+            # before this point); double-entry counted like every other
+            # edge reap (doc/edge_hardening.md).
             logger.warning("tcp peer %s too slow (%d bytes unsent); closing",
                            self.remote_addr(), buffered)
+            _edge.ledgers.count_reap("send_buffer")
             t.close()
             return
         t.write(data)
+
+    def get_write_buffer_size(self) -> int:
+        """Unsent bytes buffered in the transport — the edge plane's
+        flush gate reads this to detect a peer not draining its socket."""
+        try:
+            return self.transport.get_write_buffer_size()
+        except (AttributeError, NotImplementedError):
+            return 0
 
     def close(self) -> None:
         if not self.transport.is_closing():
@@ -431,7 +447,14 @@ async def flush_loop(interval: float = 0.001) -> None:
         flush_pending_ingest()
         for conn in drain_pending_flush():
             if not conn.is_closing() and conn.send_queue:
-                conn.flush()
+                conn.flush(fair=True)
+                if conn.send_queue and not conn.is_closing():
+                    # Fairness carry-over: the cap left entries queued;
+                    # they go out next cycle, after everyone else's turn.
+                    requeue_flush(conn)
+        # Advance the edge plane's slow-consumer/quarantine ladder —
+        # free while no peer is in distress (core/edge.py).
+        edge_tick()
         now = time.monotonic()
         if now - last_sample >= 5.0:  # asyncio_tasks gauge (goroutines analog)
             last_sample = now

@@ -179,6 +179,62 @@ class GlobalSettings:
     overload_handover_batch_cap: int = 256  # crossings/tick at L2+
     overload_retry_after_ms: int = 2000  # ServerBusyMessage back-off
 
+    # Adversarial edge plane (new — doc/edge_hardening.md): the
+    # per-connection resource envelope. Unlike the overload ladder
+    # (global, load-driven), the edge plane is PER-PEER: one broken or
+    # hostile socket is bounded, resynced, quarantined and finally
+    # disconnected without the rest of the fleet noticing.
+    edge_enabled: bool = True
+    # Egress envelope: the send queue is bounded in entries AND bytes.
+    # Past either cap the oldest entries are dropped (counted,
+    # egress_dropped_total) and every SHED-eligible subscription of the
+    # connection is marked for full-state resync — a bounded queue
+    # degrades to a coarser cadence, never to silent state loss.
+    edge_send_queue_max_msgs: int = 8192
+    edge_send_queue_max_bytes: int = 4 * 1024 * 1024
+    # Watermarks as fractions of either cap. Above HIGH the connection
+    # is a slow-consumer suspect; back under LOW it is healthy again
+    # (the gap is hysteresis — a queue oscillating around one threshold
+    # must not flap the suspect state).
+    edge_high_watermark: float = 0.5
+    edge_low_watermark: float = 0.125
+    # Sustained-high grace: a connection holding above HIGH for this
+    # long is dropped-to-resync once; holding for another full grace
+    # window after that escalates to quarantine.
+    edge_slow_grace_s: float = 2.0
+    # Quarantine -> structured disconnect deadline. While quarantined
+    # the egress queue is frozen (nothing new enqueued) and the peer is
+    # sent nothing but the final DisconnectMessage.
+    edge_quarantine_grace_s: float = 1.0
+    # Ingress accumulation bound: a per-connection frames/s cap (token
+    # bucket, burst = one second's allowance; 0 disables). Sustained
+    # violation quarantines the peer (ingress_flood). Frame-SIZE bounds
+    # are the framing layer's MAX_PACKET_SIZE (connection-fatal,
+    # counted malformed_frames_total{stage=framing}).
+    edge_max_frame_rate: int = 4000
+    # Per-tick drain fairness: send-queue entries one connection may
+    # flush per pump pass before it yields (re-queued for the next
+    # pass); 0 disables the bound. Keeps one hot connection from
+    # starving the 1ms pump for everyone else.
+    edge_flush_fair_msgs: int = 4096
+    # Transport-backpressure gate: when a connection's transport reports
+    # more than this many unsent bytes buffered (a peer not draining its
+    # socket), the shared pump stops feeding it and leaves the entries
+    # in the send queue — which is what the envelope bounds and the
+    # slow-consumer ladder watches. Without the gate a slow TCP reader
+    # hides in the transport's unbounded-in-practice write buffer until
+    # the MAX_SEND_BUFFER abort; with it the peer walks the counted
+    # ladder (resync -> quarantine -> structured disconnect) instead.
+    # 0 disables. Direct flushes (disconnect, drain) bypass the gate.
+    edge_transport_high_bytes: int = 1 << 20
+    # Auth-window deadline (-auth-deadline, ms): sockets that never
+    # complete the FSM handshake within it are reaped and counted
+    # (conn_reaped_total{reason=auth_timeout}); recovery-handle
+    # reconnects are exempt. 0 = inherit connection_auth_timeout_ms
+    # (the reference's -cat knob) so existing configs keep their
+    # behavior.
+    auth_deadline_ms: int = 0
+
     # Spatial authority failover (new — doc/failover.md). When a
     # recoverable server's recovery window expires for good, its
     # orphaned spatial cells are re-hosted onto surviving servers
@@ -347,6 +403,13 @@ class GlobalSettings:
     # (ref: spatial.go:387-590).
     tpu_mesh_devices: int = 0
     tpu_mesh_hosts: int = 1
+
+    def effective_auth_deadline_ms(self) -> int:
+        """The auth-window reap deadline the edge plane enforces:
+        -auth-deadline when set, else the reference -cat knob."""
+        if self.auth_deadline_ms > 0:
+            return self.auth_deadline_ms
+        return self.connection_auth_timeout_ms
 
     def get_channel_settings(self, ct: ChannelType) -> ChannelSettings:
         # By-value copy, like the Go struct return — mutating the result
@@ -563,6 +626,30 @@ class GlobalSettings:
         p.add_argument("-slo-config", type=str, default=self.slo_config,
                        help="JSON SLO table overriding the built-in "
                             "defaults (core/slo.py SloSpec rows)")
+        p.add_argument("-edge",
+                       type=lambda s: s.lower() not in
+                       ("false", "0", "no", "off"),
+                       default=self.edge_enabled,
+                       help="adversarial edge plane: per-connection "
+                            "resource envelopes, slow-consumer "
+                            "quarantine, ingress caps "
+                            "(doc/edge_hardening.md); false disarms "
+                            "every bound")
+        p.add_argument("-edge-queue-msgs", type=int,
+                       default=self.edge_send_queue_max_msgs,
+                       help="per-connection egress queue entry cap")
+        p.add_argument("-edge-queue-bytes", type=int,
+                       default=self.edge_send_queue_max_bytes,
+                       help="per-connection egress queue byte cap")
+        p.add_argument("-edge-frame-rate", type=int,
+                       default=self.edge_max_frame_rate,
+                       help="per-connection inbound frames/s cap "
+                            "(0 disables)")
+        p.add_argument("-auth-deadline", type=int,
+                       default=self.auth_deadline_ms,
+                       help="ms a socket may stay unauthenticated before "
+                            "it is reaped (conn_reaped_total); 0 "
+                            "inherits -cat")
         p.add_argument("-debug-affinity",
                        type=lambda s: s.lower() not in
                        ("false", "0", "no", "off"),
@@ -642,6 +729,11 @@ class GlobalSettings:
         self.trace_dump_ticks = args.trace_dump_ticks
         self.slo_enabled = args.slo
         self.slo_config = args.slo_config
+        self.edge_enabled = args.edge
+        self.edge_send_queue_max_msgs = args.edge_queue_msgs
+        self.edge_send_queue_max_bytes = args.edge_queue_bytes
+        self.edge_max_frame_rate = args.edge_frame_rate
+        self.auth_deadline_ms = args.auth_deadline
         self.debug_affinity = args.debug_affinity
         self.spatial_backend = args.spatial_backend
         self.tpu_mesh_devices = args.mesh_devices

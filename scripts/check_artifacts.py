@@ -78,6 +78,12 @@ SCHEMAS: dict[str, set] = {
     "OBS_*.json": _SOAK_KEYS | {
         "delivery", "slo", "breaches", "readyz", "fleet", "overhead",
     },
+    # Adversarial edge soak (doc/edge_hardening.md acceptance
+    # artifact): the three concurrent attacker classes, the edge
+    # ledgers, the honest census/delivery accounting, and the RSS bound.
+    "SOAK_ABUSE_*.json": _SOAK_KEYS | {
+        "attackers", "edge", "census", "delivery", "rss",
+    },
 }
 
 
@@ -227,11 +233,54 @@ def _check_obs_soak(doc: dict) -> list[str]:
     return errors
 
 
+def _check_abuse_soak(doc: dict) -> list[str]:
+    """The abuse soak's acceptance bar beyond key presence
+    (doc/edge_hardening.md): >= 3 CONCURRENT attacker classes, honest
+    census exact with delivery accounting intact, every slow reader
+    walked to a structured disconnect, every flood source banned, all
+    four edge ledgers double-entried against their metrics, and RSS
+    bounded across the attack."""
+    errors: list[str] = []
+    names = {
+        c.get("name") for c in doc.get("invariants", {}).get("checks", [])
+    }
+    for required in (
+        "honest_census_exact",
+        "honest_delivery_exact",
+        "slow_readers_structurally_disconnected",
+        "malformed_counted_at_framing",
+        "flood_sources_banned",
+        "rss_growth_bounded_mb",
+    ):
+        if required not in names:
+            errors.append(f"missing invariant check {required!r}")
+    ledger_checks = {n for n in names if n and n.endswith("_ledger_matches_metric")}
+    if len(ledger_checks) < 4:
+        errors.append("fewer than 4 ledger==metric invariant checks "
+                      f"({sorted(ledger_checks)})")
+    classes = doc.get("attackers", {}).get("classes", [])
+    if len(classes) < 3:
+        errors.append(f"fewer than 3 attacker classes ({classes})")
+    census = doc.get("census", {})
+    if census.get("survivors") != census.get("expected") \
+            or census.get("honest_disconnects"):
+        errors.append(f"honest census not clean: {census}")
+    delivery = doc.get("delivery", {})
+    if delivery.get("missing") or not delivery.get("frames_sent"):
+        errors.append(f"delivery accounting not clean: {delivery}")
+    rss = doc.get("rss", {})
+    if rss.get("growth_mb") is None or rss.get("bound_mb") is None \
+            or rss["growth_mb"] > rss["bound_mb"]:
+        errors.append(f"rss bound not proven: {rss}")
+    return errors
+
+
 EXTRA_CHECKS = {
     "SOAK_GLOBAL_*.json": _check_global_soak,
     "SOAK_DEVICE_*.json": _check_device_soak,
     "SOAK_CRASH_*.json": _check_crash_soak,
     "OBS_*.json": _check_obs_soak,
+    "SOAK_ABUSE_*.json": _check_abuse_soak,
 }
 
 

@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Seeded wire-protocol fuzz campaign against an in-process gateway
+(doc/edge_hardening.md).
+
+Drives channeld_tpu.chaos.fuzz: mutational hostile sessions (torn frames,
+oversized prefixes, bit-flipped protos, wrong-FSM-state sequences, replayed
+auth, mid-handshake closes) under the three-part oracle — no event-loop
+escape, no envelope breach, honest census exact. Violating inputs are
+minimized and written to the regression corpus.
+
+Usage:
+    python scripts/fuzz_wire.py --iterations 50000 --seed 0xC4A71E
+    python scripts/fuzz_wire.py --replay          # corpus regression only
+    python scripts/fuzz_wire.py --smoke           # CI: small, time-bounded
+
+Exit status: 0 = clean run (or all corpus replays green); 1 = violations.
+"""
+
+import argparse
+import asyncio
+import json
+import logging
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..")))
+
+from channeld_tpu.chaos import fuzz  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--iterations", type=int, default=50000)
+    ap.add_argument("--seed", type=lambda s: int(s, 0), default=0xC4A71E)
+    ap.add_argument(
+        "--corpus",
+        default=fuzz.CORPUS_DIR,
+        help="where minimized violating inputs are written (default: the "
+        "committed regression corpus)",
+    )
+    ap.add_argument(
+        "--no-minimize", action="store_true",
+        help="save violating inputs unshrunk (faster triage loops)",
+    )
+    ap.add_argument(
+        "--replay", action="store_true",
+        help="only replay the committed corpus; no new fuzzing",
+    )
+    ap.add_argument(
+        "--smoke", action="store_true",
+        help="CI preset: 3000 iterations + corpus replay",
+    )
+    ap.add_argument("--out", default="", help="write the JSON report here")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args()
+
+    if not args.verbose:
+        logging.disable(logging.CRITICAL)
+    if args.smoke:
+        args.iterations = 3000
+
+    t0 = time.monotonic()
+    report = {"replay": {}, "fuzz": None}
+
+    replay = asyncio.run(fuzz.replay_corpus(args.corpus))
+    report["replay"] = replay
+    replay_bad = {k: v for k, v in replay.items() if v}
+    print(
+        "corpus replay: %d cases, %d violating"
+        % (len(replay), len(replay_bad))
+    )
+    for name, n in replay_bad.items():
+        print("  REGRESSED: %s (%d violations)" % (name, n))
+
+    if not args.replay:
+        rep = asyncio.run(
+            fuzz.run_fuzz(
+                args.iterations,
+                seed=args.seed,
+                corpus_dir=args.corpus,
+                do_minimize=not args.no_minimize,
+                progress=lambda i, v: print(
+                    "  %d/%d iterations, %d violations" % (i, args.iterations, v),
+                    flush=True,
+                ),
+            )
+        )
+        report["fuzz"] = rep
+        print(
+            "fuzz: %d iterations, %d violations, %.1fs"
+            % (rep["iterations"], rep["total_violations"], time.monotonic() - t0)
+        )
+        for v in rep["violations"]:
+            print(
+                "  [%s] %s seed=0x%x: %s"
+                % (v["oracle"], v["kind"], v["seed"], v["detail"])
+            )
+
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
+        print("report: %s" % args.out)
+
+    failed = bool(replay_bad) or bool(
+        report["fuzz"] and report["fuzz"]["total_violations"]
+    )
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,592 @@
+"""Adversarial edge plane (core/edge.py, doc/edge_hardening.md): bounded
+per-connection resources, the slow-consumer ladder, ingress caps,
+auth-window reaping, flush fairness, the overload interaction — and the
+wire-fuzzer regression corpus (tests/corpus/wire/) replayed in tier-1.
+"""
+
+import asyncio
+import os
+import time
+
+import pytest
+
+from channeld_tpu.core import connection as connection_mod
+from channeld_tpu.core import ddos as ddos_mod
+from channeld_tpu.core import edge
+from channeld_tpu.core import metrics
+from channeld_tpu.core.channel import get_global_channel
+from channeld_tpu.core.connection import add_connection
+from channeld_tpu.core.message import MessageContext
+from channeld_tpu.core.overload import OverloadLevel, governor
+from channeld_tpu.core.settings import global_settings
+from channeld_tpu.core.types import (
+    ChannelDataAccess,
+    ConnectionState,
+    ConnectionType,
+    MessageType,
+)
+from channeld_tpu.protocol import FrameDecoder, control_pb2, encode_packet, wire_pb2
+
+from helpers import FakeTransport, fresh_runtime
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CORPUS = os.path.join(REPO, "tests", "corpus", "wire")
+
+
+@pytest.fixture(autouse=True)
+def runtime():
+    gch = fresh_runtime()
+    global_settings.development = True
+    connection_mod.set_fsm_templates(None, None)
+    yield gch
+
+
+def _ctx(msg_type=100, body=b"x" * 32, channel_id=0):
+    ctx = MessageContext(msg_type=msg_type, msg=None, channel_id=channel_id)
+    ctx.raw_body = body
+    return ctx
+
+
+def _send_raw(conn, body=b"x" * 32, msg_type=100):
+    """Queue one message through the real sender path."""
+    ctx = MessageContext(msg_type=msg_type, msg=None, channel_id=0)
+    ctx.raw_body = body
+    conn.send(ctx)
+
+
+def sent_messages(transport: FakeTransport) -> list:
+    dec = FrameDecoder()
+    out = []
+    for chunk in transport.written:
+        for packet in dec.decode_packets(chunk):
+            out.extend(packet.messages)
+    return out
+
+
+# ---- the egress envelope ---------------------------------------------------
+
+
+def test_send_queue_bounded_against_never_draining_transport():
+    """The seed hole this plane exists for: a peer that never drains must
+    not grow an unbounded send_queue (old core/connection.py kept
+    appending forever)."""
+    global_settings.edge_send_queue_max_msgs = 64
+    global_settings.edge_send_queue_max_bytes = 1 << 20
+    conn = add_connection(FakeTransport(), ConnectionType.CLIENT)
+    for _ in range(1000):  # never flushed: the transport never drains
+        _send_raw(conn)
+    assert len(conn.send_queue) <= 64
+    assert conn.envelope.queue_bytes <= 1 << 20
+    assert edge.ledgers.egress_drop_counts["queue_msgs"] > 0
+
+
+def test_send_queue_byte_cap_trims_oldest_first():
+    global_settings.edge_send_queue_max_msgs = 10_000
+    global_settings.edge_send_queue_max_bytes = 4096
+    conn = add_connection(FakeTransport(), ConnectionType.CLIENT)
+    for i in range(64):
+        _send_raw(conn, body=bytes([i & 0xFF]) * 256)
+    assert conn.envelope.queue_bytes <= 4096
+    # Oldest entries went first: the queue's head is a LATER body.
+    assert conn.send_queue[0][4][0] > 0
+    assert edge.ledgers.egress_drop_counts["queue_bytes"] > 0
+
+
+def test_queue_bytes_ledger_tracks_flush():
+    conn = add_connection(FakeTransport(), ConnectionType.CLIENT)
+    for _ in range(10):
+        _send_raw(conn)
+    assert conn.envelope.queue_bytes > 0
+    conn.flush()
+    assert conn.envelope.queue_bytes == 0
+    assert len(conn.send_queue) == 0
+
+
+def test_cap_breach_marks_full_resync_on_shed_eligible_subs():
+    from channeld_tpu.core.subscription import subscribe_to_channel
+
+    global_settings.edge_send_queue_max_msgs = 8
+    gch = get_global_channel()
+    conn = add_connection(FakeTransport(), ConnectionType.CLIENT)
+    cs, _ = subscribe_to_channel(conn, gch, None)
+    assert cs.priority >= 1  # READ_ACCESS default: SHED-eligible
+    cs.fanout_conn.had_first_fanout = True
+    for _ in range(20):
+        _send_raw(conn)
+    assert cs.fanout_conn.had_first_fanout is False  # full resync forced
+
+
+def test_write_access_subs_exempt_from_resync():
+    from channeld_tpu.core.subscription import subscribe_to_channel
+
+    global_settings.edge_send_queue_max_msgs = 8
+    gch = get_global_channel()
+    conn = add_connection(FakeTransport(), ConnectionType.CLIENT)
+    opts = control_pb2.ChannelSubscriptionOptions(
+        dataAccess=ChannelDataAccess.WRITE_ACCESS
+    )
+    cs, _ = subscribe_to_channel(conn, gch, opts)
+    assert cs.priority == 0
+    cs.fanout_conn.had_first_fanout = True
+    for _ in range(20):
+        _send_raw(conn)
+    assert cs.fanout_conn.had_first_fanout is True  # authority spared
+
+
+# ---- the slow-consumer ladder ---------------------------------------------
+
+
+def _fill_past_high(conn, n=None):
+    n = n or int(global_settings.edge_send_queue_max_msgs
+                 * global_settings.edge_high_watermark + 2)
+    for _ in range(n):
+        _send_raw(conn)
+
+
+def test_slow_consumer_ladder_resync_then_quarantine_then_disconnect():
+    global_settings.edge_send_queue_max_msgs = 100
+    global_settings.edge_slow_grace_s = 1.0
+    global_settings.edge_quarantine_grace_s = 1.0
+    t = FakeTransport()
+    conn = add_connection(t, ConnectionType.CLIENT)
+    # high_since is stamped with the real monotonic clock; tick against it.
+    now = time.monotonic()
+
+    _fill_past_high(conn)
+    assert edge.suspect_count() == 1
+    edge.edge_tick(now)  # inside grace: nothing yet
+    assert len(conn.send_queue) > 0
+
+    # First offense after the grace: drop-to-full-resync + probation.
+    edge.edge_tick(now + 1.5)
+    assert len(conn.send_queue) == 0
+    assert conn.envelope.resynced is True
+    assert edge.ledgers.egress_drop_counts["slow_consumer"] > 0
+    assert not edge.is_quarantined(conn)
+
+    # Refill + sustain inside probation: quarantine.
+    _fill_past_high(conn)
+    edge.edge_tick(now + 4.0)
+    assert edge.is_quarantined(conn)
+    assert edge.ledgers.quarantine_counts["slow_consumer"] == 1
+
+    # Quarantine grace expires: structured disconnect hits the wire.
+    edge.edge_tick(now + 5.5)
+    assert conn.is_closing()
+    disc = [m for m in sent_messages(t)
+            if m.msgType == MessageType.DISCONNECT]
+    assert len(disc) == 1
+    msg = control_pb2.DisconnectMessage()
+    msg.ParseFromString(disc[0].msgBody)
+    assert msg.connId == conn.id
+    assert edge.ledgers.reap_counts["quarantine"] == 1
+
+
+def test_recovered_reader_is_forgiven_after_probation():
+    global_settings.edge_send_queue_max_msgs = 100
+    global_settings.edge_slow_grace_s = 1.0
+    conn = add_connection(FakeTransport(), ConnectionType.CLIENT)
+    now = time.monotonic()
+    _fill_past_high(conn)
+    edge.edge_tick(now + 1.5)  # resync fired
+    assert conn.envelope.resynced is True
+    # Quiet through the whole probation window: forgiven.
+    edge.edge_tick(now + 1.5 + edge.PROBATION_GRACE_MULT * 1.0 + 0.1)
+    assert conn.envelope.resynced is False
+    assert edge.suspect_count() == 0
+    assert not edge.is_quarantined(conn)
+
+
+def test_real_drain_exits_suspect_at_low_watermark():
+    global_settings.edge_send_queue_max_msgs = 100
+    conn = add_connection(FakeTransport(), ConnectionType.CLIENT)
+    _fill_past_high(conn)
+    assert edge.suspect_count() == 1
+    conn.flush()  # a REAL drain (note_drain), not a forced drop
+    assert edge.suspect_count() == 0
+    assert conn.envelope.high_since is None
+
+
+def test_quarantine_freezes_egress_and_ingress():
+    conn = add_connection(FakeTransport(), ConnectionType.CLIENT)
+    edge.quarantine(conn, "slow_consumer")
+    before = edge.ledgers.egress_drop_counts.get("quarantine", 0)
+    _send_raw(conn)
+    assert len(conn.send_queue) == 0  # dropped, not queued
+    assert edge.ledgers.egress_drop_counts["quarantine"] == before + 1
+    # Ingress discarded wholesale.
+    conn.on_bytes(encode_packet(wire_pb2.Packet(messages=[
+        wire_pb2.MessagePack(channelId=0, msgType=100, msgBody=b"x")])))
+    assert not conn.has_pending()
+
+
+# ---- ingress caps ----------------------------------------------------------
+
+
+def test_ingress_flood_strikes_then_quarantines():
+    global_settings.edge_max_frame_rate = 10
+    conn = add_connection(FakeTransport(), ConnectionType.CLIENT)
+    # Three consecutive over-rate reads (bucket holds 10; charge 50 each).
+    assert edge.note_frames(conn, 50) is True   # strike 1
+    assert edge.note_frames(conn, 50) is True   # strike 2
+    assert edge.note_frames(conn, 50) is False  # strike 3: quarantined
+    assert edge.is_quarantined(conn)
+    assert edge.ledgers.quarantine_counts["ingress_flood"] == 1
+
+
+def test_ingress_calm_window_forgives_strikes():
+    global_settings.edge_max_frame_rate = 10
+    conn = add_connection(FakeTransport(), ConnectionType.CLIENT)
+    env = conn.envelope
+    assert edge.note_frames(conn, 50) is True
+    assert env.flood_strikes == 1
+    # A calm read after the forget window clears the strike count.
+    env.last_violation -= edge.FLOOD_FORGET_S + 0.1
+    env.tokens = 10.0
+    assert edge.note_frames(conn, 1) is True
+    assert env.flood_strikes == 0
+
+
+def test_frame_rate_cap_disabled_with_zero():
+    global_settings.edge_max_frame_rate = 0
+    conn = add_connection(FakeTransport(), ConnectionType.CLIENT)
+    for _ in range(50):
+        assert edge.note_frames(conn, 10_000) is True
+    assert not edge.is_quarantined(conn)
+
+
+# ---- hostile sockets through the real receive path -------------------------
+
+
+def test_half_open_socket_reaped_cleanly():
+    """Peer sends half a frame then goes silent (half-open TCP): the
+    decoder holds the partial, teardown leaves no registry residue."""
+    conn = add_connection(FakeTransport(), ConnectionType.CLIENT)
+    frame = encode_packet(wire_pb2.Packet(messages=[
+        wire_pb2.MessagePack(channelId=0, msgType=100, msgBody=b"y" * 64)]))
+    conn.on_bytes(frame[: len(frame) // 2])
+    assert not conn.is_closing()
+    conn.close(unexpected=True)
+    assert conn.id not in connection_mod._all_connections
+    assert edge.suspect_count() == 0
+
+
+def test_mid_frame_close_then_more_bytes_is_noop():
+    conn = add_connection(FakeTransport(), ConnectionType.CLIENT)
+    frame = encode_packet(wire_pb2.Packet(messages=[
+        wire_pb2.MessagePack(channelId=0, msgType=100, msgBody=b"z" * 64)]))
+    conn.on_bytes(frame[:3])
+    conn.close(unexpected=True)
+    conn.on_bytes(frame[3:])  # late bytes after close: swallowed
+    assert not conn.has_pending()
+
+
+def test_oversized_length_prefix_held_without_blowup():
+    """Header claims the max size; the body never arrives. The decoder
+    buffers the partial frame (bounded by the 16-bit size field) and the
+    connection closes cleanly."""
+    conn = add_connection(FakeTransport(), ConnectionType.CLIENT)
+    conn.on_bytes(b"CH\xff\xff\x00" + b"A" * 100)
+    assert not conn.is_closing()  # legal: just a big pending frame
+    conn.close()
+    assert conn.id not in connection_mod._all_connections
+
+
+def test_bad_magic_is_connection_fatal_and_counted():
+    before = edge.ledgers.malformed_counts.get("framing", 0)
+    conn = add_connection(FakeTransport(), ConnectionType.CLIENT)
+    conn.on_bytes(b"XX\x00\x04\x00junk")
+    assert conn.is_closing()
+    assert edge.ledgers.malformed_counts["framing"] == before + 1
+
+
+def test_garbage_protobuf_counted_as_packet_stage():
+    before = edge.ledgers.malformed_counts.get("packet", 0)
+    conn = add_connection(FakeTransport(), ConnectionType.CLIENT)
+    body = b"\xde\xad\xbe\xef" * 8
+    conn.on_bytes(b"CH" + len(body).to_bytes(2, "big") + b"\x00" + body)
+    assert conn.is_closing()
+    assert edge.ledgers.malformed_counts["packet"] == before + 1
+
+
+# ---- auth-window reaping ---------------------------------------------------
+
+
+def test_auth_deadline_reaps_and_counts():
+    global_settings.auth_deadline_ms = 50
+    conn = add_connection(FakeTransport(), ConnectionType.CLIENT)
+    assert conn.id in ddos_mod._unauthenticated_connections
+    conn.conn_time = time.monotonic() - 1.0  # past the window
+    before = edge.ledgers.reap_counts.get("auth_timeout", 0)
+    ddos_mod.check_unauth_conns_once()
+    assert conn.is_closing()
+    assert edge.ledgers.reap_counts["auth_timeout"] == before + 1
+    assert ddos_mod.is_ip_banned("127.0.0.1")
+
+
+def test_auth_deadline_defaults_to_connection_auth_timeout():
+    global_settings.auth_deadline_ms = 0
+    global_settings.connection_auth_timeout_ms = 7000
+    assert global_settings.effective_auth_deadline_ms() == 7000
+    global_settings.auth_deadline_ms = 123
+    assert global_settings.effective_auth_deadline_ms() == 123
+
+
+def test_recovery_claimed_socket_exempt_from_auth_reap():
+    from channeld_tpu.core import connection_recovery as recovery_mod
+
+    global_settings.auth_deadline_ms = 50
+    conn = add_connection(FakeTransport(), ConnectionType.CLIENT)
+    conn.conn_time = time.monotonic() - 1.0
+    handle = recovery_mod.ConnectionRecoverHandle(
+        prev_conn_id=999, disconn_time=time.monotonic()
+    )
+    handle.new_conn = conn
+    recovery_mod._recover_handles["pit-resume"] = handle
+    ddos_mod.check_unauth_conns_once()
+    assert not conn.is_closing()  # mid-resume: spared
+    assert not ddos_mod.is_ip_banned("127.0.0.1")
+
+
+def test_authenticated_connection_not_reaped():
+    global_settings.auth_deadline_ms = 50
+    conn = add_connection(FakeTransport(), ConnectionType.CLIENT)
+    conn.conn_time = time.monotonic() - 1.0
+    conn.on_authenticated("pit-ok")
+    ddos_mod.check_unauth_conns_once()
+    assert not conn.is_closing()
+
+
+# ---- flush fairness --------------------------------------------------------
+
+
+def test_fair_flush_caps_one_pump_call():
+    global_settings.edge_flush_fair_msgs = 16
+    global_settings.edge_send_queue_max_msgs = 1000
+    t = FakeTransport()
+    conn = add_connection(t, ConnectionType.CLIENT)
+    for _ in range(40):
+        _send_raw(conn)
+    conn.flush(fair=True)
+    assert len(conn.send_queue) == 24  # 40 - 16 stayed for next cycle
+    conn.flush(fair=True)
+    conn.flush(fair=True)
+    assert len(conn.send_queue) == 0
+    assert len(sent_messages(t)) == 40  # nothing lost to fairness
+
+
+def test_unfair_flush_drains_fully():
+    global_settings.edge_flush_fair_msgs = 16
+    conn = add_connection(FakeTransport(), ConnectionType.CLIENT)
+    for _ in range(40):
+        _send_raw(conn)
+    conn.flush()  # direct callers (disconnect/drain) take everything
+    assert len(conn.send_queue) == 0
+
+
+class _CongestedTransport(FakeTransport):
+    """A transport whose peer is not draining: the write buffer reports
+    a fixed backlog to the flush gate."""
+
+    def __init__(self, backlog: int):
+        super().__init__()
+        self.backlog = backlog
+
+    def get_write_buffer_size(self) -> int:
+        return self.backlog
+
+
+def test_fair_flush_defers_on_congested_transport():
+    """A slow TCP reader must land in the envelope, not the transport
+    buffer: past edge_transport_high_bytes the pump leaves the queue
+    alone (the ladder watches it); direct flush still bypasses."""
+    global_settings.edge_transport_high_bytes = 1024
+    t = _CongestedTransport(backlog=4096)
+    conn = add_connection(t, ConnectionType.CLIENT)
+    for _ in range(10):
+        _send_raw(conn)
+    conn.flush(fair=True)
+    assert len(conn.send_queue) == 10  # gate held everything back
+    assert not t.written
+    t.backlog = 0  # peer drained: next pump pass flows again
+    conn.flush(fair=True)
+    assert len(conn.send_queue) == 0
+    assert len(sent_messages(t)) == 10
+
+
+def test_direct_flush_bypasses_transport_gate():
+    global_settings.edge_transport_high_bytes = 1024
+    t = _CongestedTransport(backlog=1 << 20)
+    conn = add_connection(t, ConnectionType.CLIENT)
+    for _ in range(5):
+        _send_raw(conn)
+    conn.flush()  # disconnect/drain path: everything goes out
+    assert len(conn.send_queue) == 0
+    assert len(sent_messages(t)) == 5
+
+
+def test_send_buffer_backstop_abort_is_counted():
+    """The MAX_SEND_BUFFER abort behind the gate is an edge reap and
+    must be double-entry counted (reason=send_buffer)."""
+    from channeld_tpu.core.server import MAX_SEND_BUFFER, TcpTransport
+
+    class _Inner:
+        def __init__(self):
+            self.closed = False
+
+        def set_write_buffer_limits(self, high=None):
+            pass
+
+        def is_closing(self):
+            return self.closed
+
+        def get_write_buffer_size(self):
+            return MAX_SEND_BUFFER
+
+        def get_extra_info(self, name):
+            return ("127.0.0.1", 1234)
+
+        def write(self, data):
+            raise AssertionError("backstop must not write")
+
+        def close(self):
+            self.closed = True
+
+    t = TcpTransport(_Inner())
+    before = edge.ledgers.reap_counts.get("send_buffer", 0)
+    m_before = _sample(metrics.conn_reaped, reason="send_buffer")
+    t.write(b"x")
+    assert t.transport.closed
+    assert edge.ledgers.reap_counts["send_buffer"] == before + 1
+    assert _sample(metrics.conn_reaped, reason="send_buffer") == m_before + 1
+    t.write(b"y")  # already closing: no double count
+    assert edge.ledgers.reap_counts["send_buffer"] == before + 1
+
+
+# ---- overload interaction --------------------------------------------------
+
+
+def test_edge_pressure_feeds_governor():
+    global_settings.overload_backlog_norm = 10
+    conn = add_connection(FakeTransport(), ConnectionType.CLIENT)
+    global_settings.edge_send_queue_max_msgs = 100
+    _fill_past_high(conn)
+    assert edge.pressure() == pytest.approx(0.1)
+    governor.update(0.01)
+    # The raw-max pressure signal carries the edge component (the EWMA
+    # smooths the headline number; the component is exact).
+    assert governor.components["edge"] == pytest.approx(0.1)
+
+
+def test_quarantine_is_per_peer_under_l3():
+    """Quarantine x overload-L3: the global ladder at L3 must not stop a
+    per-peer structured disconnect, and the disconnect must not disturb
+    other connections."""
+    global_settings.overload_up_hold_ticks = 1
+    global_settings.edge_quarantine_grace_s = 0.5
+    t_bad, t_good = FakeTransport(), FakeTransport()
+    bad = add_connection(t_bad, ConnectionType.CLIENT)
+    good = add_connection(t_good, ConnectionType.CLIENT)
+    good.on_authenticated("good-pit")
+    for _ in range(20):  # saturate: governor to L3
+        governor.note_tick(0.05, 0.01)
+        governor.update(0.01)
+    assert governor.level == OverloadLevel.L3
+
+    edge.quarantine(bad, "slow_consumer")
+    edge.edge_tick(time.monotonic() + 1.0)
+    assert bad.is_closing()
+    assert [m for m in sent_messages(t_bad)
+            if m.msgType == MessageType.DISCONNECT]
+    assert not good.is_closing()
+    assert good.state == ConnectionState.AUTHENTICATED
+    assert edge.quarantined_count() == 0
+
+
+# ---- double-entry: ledgers == prometheus -----------------------------------
+
+
+def _sample(counter, **labels):
+    return counter.labels(**labels)._value.get()
+
+
+def test_ledgers_match_metrics():
+    conn = add_connection(FakeTransport(), ConnectionType.CLIENT)
+    q0 = _sample(metrics.conn_quarantine, reason="slow_consumer")
+    e0 = _sample(metrics.egress_dropped, reason="quarantine")
+    r0 = _sample(metrics.conn_reaped, reason="quarantine")
+    m0 = _sample(metrics.malformed_frames, stage="framing")
+
+    _send_raw(conn)
+    edge.quarantine(conn, "slow_consumer")
+    edge.edge_tick(time.monotonic() + 10.0)
+    bad = add_connection(FakeTransport(), ConnectionType.CLIENT)
+    bad.on_bytes(b"ZZ\x00\x01\x00q")
+
+    snap = edge.snapshot()
+    assert (_sample(metrics.conn_quarantine, reason="slow_consumer") - q0
+            == snap["quarantine_counts"]["slow_consumer"] == 1)
+    assert (_sample(metrics.egress_dropped, reason="quarantine") - e0
+            == snap["egress_drop_counts"]["quarantine"] == 1)
+    assert (_sample(metrics.conn_reaped, reason="quarantine") - r0
+            == snap["reap_counts"]["quarantine"] == 1)
+    assert (_sample(metrics.malformed_frames, stage="framing") - m0
+            == snap["malformed_counts"]["framing"] == 1)
+
+
+def test_edge_disabled_is_inert():
+    global_settings.edge_enabled = False
+    conn = add_connection(FakeTransport(), ConnectionType.CLIENT)
+    for _ in range(100):
+        _send_raw(conn)
+    assert len(conn.send_queue) == 100  # unbounded again, by choice
+    assert conn.envelope.queue_bytes == 0
+    assert edge.suspect_count() == 0
+
+
+# ---- the fuzzer + regression corpus ----------------------------------------
+
+
+def test_corpus_replays_green():
+    """Every committed corpus case (minimized defects + pinned sentinels)
+    replays with zero oracle violations. Budget: <60s tier-1."""
+    from channeld_tpu.chaos.fuzz import replay_corpus
+
+    t0 = time.monotonic()
+    results = asyncio.run(replay_corpus(CORPUS))
+    elapsed = time.monotonic() - t0
+    assert results, "regression corpus is missing"
+    bad = {k: v for k, v in results.items() if v}
+    assert not bad, f"corpus regressions: {bad}"
+    assert elapsed < 60.0
+
+
+def test_fuzz_smoke_short_campaign():
+    """A short seeded campaign end-to-end (the CI smoke job runs a bigger
+    one): zero violations, and the harness exercised every oracle arm."""
+    from channeld_tpu.chaos.fuzz import run_fuzz
+
+    rep = asyncio.run(run_fuzz(400, seed=0xED6E, do_minimize=False,
+                               roundtrip_every=100))
+    assert rep["total_violations"] == 0
+    assert len(rep["kinds"]) >= 10  # the family mix actually rotated
+
+
+def test_fuzz_is_deterministic():
+    from channeld_tpu.chaos.fuzz import make_case
+
+    a = make_case(42, 7)
+    b = make_case(42, 7)
+    assert a.kind == b.kind and a.ops == b.ops and a.seed == b.seed
+    c = make_case(43, 7)
+    assert (a.kind, a.ops) != (c.kind, c.ops) or a.seed != c.seed
+
+
+def test_fuzz_case_json_roundtrip():
+    from channeld_tpu.chaos.fuzz import FuzzCase, make_case
+
+    case = make_case(1, 1)
+    again = FuzzCase.from_json(case.to_json())
+    assert again.kind == case.kind
+    assert again.ops == case.ops
+    assert again.auth_first == case.auth_first
