@@ -229,6 +229,7 @@ INSTANCES: dict[str, tuple] = {
     "fleet": (("federation/obs.py", "FleetObs"),),
     "chaos": (("chaos/injector.py", "ChaosInjector"),),
     "balancer": (("spatial/balancer.py", "BalancerPlane"),),
+    "partition": (("spatial/partition.py", "PartitionPlane"),),
     "engine": (("ops/engine.py", "SpatialEngine"),),
     # SLO per-second rings: not singletons, but the one non-singleton
     # hop that crosses threads (the WAL writer feeds wal_fsync events).

@@ -205,6 +205,43 @@ balancer_imbalance = Gauge(
     registry=registry,
 )
 
+# Adaptive partitioning plane (spatial/partition.py; doc/partitioning.md).
+spatial_cell_depth = Gauge(
+    "spatial_cell_depth",
+    "Quadtree depth of one live leaf cell (0 == base grid; published "
+    "for every live leaf each governor evaluation, zeroed when the "
+    "leaf is split away or merged back)",
+    ["cell"],
+    registry=registry,
+)
+partition_ops = Counter(
+    "partition_ops",
+    "Adaptive-partitioning geometry operations by terminal result "
+    "(op=split|merge; result=committed: geometry epoch advanced, "
+    "entities repartitioned zero-loss; aborted: deterministic rollback "
+    "— drain timeout, owner loss, or overload outranked; vetoed: never "
+    "planned because the overload ladder sat at L2+ or the depth/"
+    "in-flight guards refused; python ledger in spatial/partition.py "
+    "must match exactly)",
+    ["op", "result"],
+    registry=registry,
+)
+partition_geometry_epoch = Gauge(
+    "partition_geometry_epoch",
+    "Monotonic cell-geometry epoch (bumps on every committed split/"
+    "merge and every adopted remote geometry; 0 == boot static grid)",
+    registry=registry,
+)
+partition_device_rebuilds = Counter(
+    "partition_device_rebuilds",
+    "Device micro-grid rebuilds triggered by geometry epochs whose max "
+    "active depth changed (result=verified: rebuilt arrays bit-identical "
+    "to the host shadow; mismatch: verify_device_state found divergence "
+    "— flight recorder force-dumps)",
+    ["result"],
+    registry=registry,
+)
+
 # Cross-gateway federation plane (channeld_tpu/federation;
 # doc/federation.md).
 federation_handover = Counter(

@@ -283,6 +283,37 @@ class GlobalSettings:
     # ladder at L2+ vetoes ALL migrations regardless).
     balancer_dest_pressure_max: float = 1.15
 
+    # Adaptive partitioning (new — doc/partitioning.md). Cell geometry
+    # becomes a runtime, versioned property: a density governor splits
+    # hot cells quadtree-style and merges cold sibling groups back,
+    # executed as transactional geometry epochs (freeze -> drain ->
+    # commit/abort) riding the balancer's freeze machinery and the WAL.
+    # OFF by default: every pre-existing envelope assumes the static
+    # grid; soaks that want it opt in explicitly.
+    partition_enabled: bool = False
+    # Structural depth bound: the cell-id blocks for depths
+    # 0..partition_max_depth are reserved at load (validated against
+    # entity_channel_id_start), whether or not the governor is enabled.
+    partition_max_depth: int = 2
+    # Split when a cell's resident entities hold at/above this; merge a
+    # sibling group when the group TOTAL holds at/below the merge
+    # threshold (kept well apart — two-sided hysteresis, no flapping).
+    partition_split_entities: int = 48
+    partition_merge_entities: int = 12
+    # Consecutive over/under-threshold evaluations before acting, and
+    # GLOBAL ticks between evaluations.
+    partition_hold_ticks: int = 3
+    partition_eval_ticks: int = 30
+    # Committed geometry ops per epoch, epoch length, and per-cell
+    # re-op lockout — the balancer's anti-flap discipline.
+    partition_budget_per_epoch: int = 1
+    partition_epoch_ticks: int = 300
+    partition_cooldown_ticks: int = 600
+    # Freeze-phase bounds (GLOBAL ticks): minimum freeze before the
+    # repartition snapshot; a handover journal that never drains aborts.
+    partition_freeze_min_ticks: int = 2
+    partition_drain_deadline_ticks: int = 120
+
     # Cross-gateway federation plane (new — doc/federation.md). Empty
     # config path = the plane stays disarmed and every hook is a cheap
     # no-op (the gateway is a self-contained world, the pre-federation
@@ -556,6 +587,32 @@ class GlobalSettings:
                        default=self.balancer_cooldown_ticks,
                        help="GLOBAL ticks a migrated cell is locked out "
                             "of re-migration (anti-oscillation)")
+        p.add_argument("-partition",
+                       type=lambda s: s.lower() not in
+                       ("false", "0", "no", "off"),
+                       default=self.partition_enabled,
+                       help="adaptive partitioning: live quadtree cell "
+                            "split/merge under extreme density "
+                            "(doc/partitioning.md); false pins the "
+                            "static grid geometry")
+        p.add_argument("-partition-split", type=int,
+                       default=self.partition_split_entities,
+                       help="resident entities at/above which a cell is "
+                            "planned for a split")
+        p.add_argument("-partition-merge", type=int,
+                       default=self.partition_merge_entities,
+                       help="sibling-group total at/below which a merge "
+                            "is planned")
+        p.add_argument("-partition-depth", type=int,
+                       default=self.partition_max_depth,
+                       help="max quadtree split depth (id space for all "
+                            "depths is validated against the entity "
+                            "channel id start)")
+        p.add_argument("-partition-budget", type=int,
+                       default=self.partition_budget_per_epoch,
+                       help="committed geometry ops allowed per epoch "
+                            "(epoch = partition_epoch_ticks GLOBAL "
+                            "ticks)")
         p.add_argument("-fed", type=str, default="",
                        help="federation config JSON path (shard directory "
                             "+ trunk addresses, doc/federation.md); empty "
@@ -710,6 +767,16 @@ class GlobalSettings:
         )
         self.balancer_budget_per_epoch = args.balancer_budget
         self.balancer_cooldown_ticks = args.balancer_cooldown
+        self.partition_enabled = args.partition
+        self.partition_split_entities = args.partition_split
+        # Keep the merge threshold strictly under the split threshold or
+        # the two-sided density hysteresis band inverts (split one
+        # epoch, merge the next, forever).
+        self.partition_merge_entities = min(
+            args.partition_merge, args.partition_split // 2,
+        )
+        self.partition_max_depth = args.partition_depth
+        self.partition_budget_per_epoch = args.partition_budget
         self.federation_config = args.fed
         self.federation_gateway_id = args.fed_id
         self.global_control_enabled = args.global_control

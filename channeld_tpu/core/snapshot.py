@@ -86,6 +86,14 @@ def _pack_extras(snap: snapshot_pb2.GatewaySnapshot) -> None:
         for cid, gw in sorted(directory.overrides().items()):
             snap.overrideCells.append(cid)
             snap.overrideGateways.append(gw)
+    # Cell geometry (adaptive partitioning): checkpoint truncation drops
+    # the WAL's geometry records, so the snapshot must carry them.
+    from ..spatial.controller import get_spatial_controller
+
+    _ctl = get_spatial_controller()
+    if _ctl is not None and getattr(_ctl, "tree", None) is not None:
+        snap.geometryEpoch = _ctl.tree.epoch
+        snap.splitCells.extend(sorted(_ctl.tree.splits))
     # In-flight handover transactions (an entity mid-crossing is in
     # NEITHER cell's data — same blindness the epoch replica closes).
     # Remote records carry their trunk batch identity for the
@@ -220,6 +228,7 @@ def extras_from(snap: snapshot_pb2.GatewaySnapshot) -> dict:
             (a.initiator, a.batchId): (a.dstChannelId, list(a.entityIds))
             for a in snap.applied
         },
+        "geometry": (snap.geometryEpoch, frozenset(snap.splitCells)),
     }
 
 
@@ -232,6 +241,9 @@ def restore_snapshot(path: str) -> int:
     snap = load_snapshot(path)
     restored = boot_restore_channels(snap)
     extras = extras_from(snap)
+    from .wal import apply_restored_geometry
+
+    apply_restored_geometry(*extras["geometry"])
     from .ddos import restore_blacklists
 
     restore_blacklists(extras["banned_ips"], extras["banned_pits"])

@@ -71,6 +71,9 @@ class MessageType(IntEnum):
     CELL_REHOSTED = 25
     CELL_MIGRATED = 26
     CLIENT_REDIRECT = 27
+    # Adaptive partitioning (spatial/partition.py, 28;
+    # doc/partitioning.md).
+    CELL_GEOMETRY_UPDATE = 28
     # Federation trunk plane (gateway<->gateway links only, 30-37;
     # doc/federation.md).
     TRUNK_HELLO = 30

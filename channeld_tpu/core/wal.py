@@ -355,6 +355,15 @@ class WriteAheadLog:
             w.overrideGateways.append(gw)
         self.append("directory", w)
 
+    def log_geometry(self, epoch: int, splits) -> None:
+        """One record per geometry epoch bump (adaptive partitioning,
+        spatial/partition.py): the full split set, last record wins at
+        replay. Written BEFORE any mutation the split/merge implies —
+        this record IS the transaction's commit point."""
+        self.append("geometry", wal_pb2.WalRecord(
+            geometryEpoch=epoch, splitCells=sorted(splits),
+        ))
+
     def log_blacklist(self, kind: str, key: str) -> None:
         self.append("blacklist", wal_pb2.WalRecord(
             blacklistKind=kind, blacklistKey=key,
@@ -580,6 +589,9 @@ def boot_replay(snapshot_path: str, wal_path: str) -> dict:
     )
     banned_ips = set(extras["banned_ips"]) if extras else set()
     banned_pits = set(extras["banned_pits"]) if extras else set()
+    geometry_state = (
+        extras["geometry"] if extras is not None else (0, frozenset())
+    )
     flips: dict[int, int] = {}
     for r in records:
         k = r.kind
@@ -629,8 +641,17 @@ def boot_replay(snapshot_path: str, wal_path: str) -> dict:
                 banned_ips.add(r.blacklistKey)
             else:
                 banned_pits.add(r.blacklistKey)
+        elif k == "geometry":
+            geometry_state = (r.geometryEpoch, frozenset(r.splitCells))
         else:
             logger.warning("unknown WAL record kind %r skipped", k)
+
+    # ---- cell geometry (before channel images: a geometry record was
+    # the commit point of a split/merge whose implied mutations may be
+    # partially lost — the images must land under the geometry the
+    # record committed, and the re-home guard below fixes the rest) ----
+    if apply_restored_geometry(*geometry_state):
+        wal._count_replayed("geometry")
 
     # ---- apply channel images --------------------------------------------
     from .channel import create_channel_with_id, get_channel, remove_channel
@@ -671,6 +692,21 @@ def boot_replay(snapshot_path: str, wal_path: str) -> dict:
         if ch is not None and not ch.is_removing():
             remove_channel(ch)
         wal._count_replayed("channel_removed")
+
+    # ---- geometry re-home guard ------------------------------------------
+    # A crash AFTER the geometry commit point but before the implied
+    # moves drained leaves entity rows in cells that are no longer live
+    # leaves (a split parent's image, or an orphaned child after a
+    # merge). Deterministically re-home each into a live leaf — the
+    # flip target if it is one (the move's commit landed), else the
+    # leaf containing the stale cell's center — skipping entities whose
+    # row already survived elsewhere (zero-dupe), then drop the stale
+    # channels. Runs before the ledger re-seed so the ledger only ever
+    # sees the final rows.
+    rehomed = _rehome_nonleaf_cells(flips)
+    if rehomed:
+        wal._count_replayed("geometry_rehome", rehomed)
+        report["geometry_rehomed"] = rehomed
 
     # ---- controller re-seed (ledger + device tracking) -------------------
     _reseed_controller(flips)
@@ -744,6 +780,133 @@ def boot_replay(snapshot_path: str, wal_path: str) -> dict:
     return report
 
 
+def apply_restored_geometry(epoch: int, splits) -> bool:
+    """Apply a snapshot/WAL-restored cell geometry to the live spatial
+    controller (adaptive partitioning, doc/partitioning.md). Monotonic:
+    a restored epoch at or below the controller's current one is a
+    no-op (the restart path replays into an already-current world).
+    Returns True when the geometry actually changed."""
+    from ..spatial.controller import get_spatial_controller
+
+    ctl = get_spatial_controller()
+    tree = getattr(ctl, "tree", None) if ctl is not None else None
+    if tree is None:
+        if epoch:
+            logger.warning(
+                "restored geometry epoch %d has no spatial controller "
+                "tree to land on; ignored", epoch,
+            )
+        return False
+    if epoch <= tree.epoch and not (epoch == 0 and tree.epoch == 0):
+        return False
+    if not epoch and not splits:
+        return False
+    try:
+        ctl.apply_geometry(epoch, frozenset(splits))
+    except ValueError as e:
+        logger.error(
+            "restored geometry epoch %d invalid (%s); keeping epoch %d",
+            epoch, e, tree.epoch,
+        )
+        return False
+    logger.info(
+        "boot replay: cell geometry restored to epoch %d (%d split "
+        "cells)", epoch, len(splits),
+    )
+    return True
+
+
+def _rehome_nonleaf_cells(flips: dict[int, int]) -> int:
+    """Re-home entity rows restored into cells that are not live leaves
+    under the final geometry, then remove those stale channels; remap
+    ``flips`` rows that target non-leaf cells the same way. Returns the
+    number of entities moved."""
+    from ..spatial.controller import get_spatial_controller
+    from .channel import (
+        all_channels, create_channel_with_id, get_channel, remove_channel,
+    )
+
+    ctl = get_spatial_controller()
+    tree = getattr(ctl, "tree", None) if ctl is not None else None
+    if tree is None:
+        return 0
+    st = global_settings
+    lo, hi = st.spatial_channel_id_start, st.entity_channel_id_start
+
+    def _live_leaf(cell: int) -> bool:
+        try:
+            return tree.exists(cell) and tree.is_leaf(cell)
+        except ValueError:
+            return False
+
+    def _center_leaf(cell: int):
+        try:
+            cx, cz = tree.center(cell)
+        except ValueError:
+            return None
+        return tree.leaf_at(cx, cz)
+
+    stale = sorted(
+        (cid, ch) for cid, ch in all_channels().items()
+        if lo <= cid < hi and not ch.is_removing()
+        and not _live_leaf(cid)
+    )
+    moved = 0
+    for cid, ch in stale:
+        ents = dict(getattr(ch.get_data_message(), "entities", None) or {})
+        for eid in sorted(ents):
+            # Zero-dupe: if a live row for this entity survived in any
+            # other cell image, that row wins and this one just drops
+            # with the stale channel.
+            if any(
+                eid in (getattr(c2.get_data_message(), "entities", None)
+                        or {})
+                for cid2, c2 in all_channels().items()
+                if lo <= cid2 < hi and cid2 != cid
+                and not c2.is_removing()
+            ):
+                continue
+            tgt = flips.get(eid)
+            if tgt is None or not _live_leaf(tgt):
+                tgt = _center_leaf(cid)
+            if tgt is None:
+                continue
+            tch = get_channel(tgt)
+            if tch is None or tch.is_removing():
+                tch = create_channel_with_id(
+                    tgt, ChannelType.SPATIAL, ch.get_owner()
+                )
+                data_msg = ch.get_data_message()
+                tch.init_data(
+                    type(data_msg)() if data_msg is not None else None,
+                    getattr(ch.data, "merge_options", None),
+                )
+            adder = getattr(tch.get_data_message(), "add_entity", None)
+            data = ents[eid]
+            if adder is not None and data is not None:
+                adder(eid, data)
+                flips[eid] = tgt
+                moved += 1
+        logger.info(
+            "boot replay: cell %d is not a live leaf under geometry "
+            "epoch %d; %d resident entities re-homed, channel dropped",
+            cid, tree.epoch, len(ents),
+        )
+        remove_channel(ch)
+    # Flips that point at non-leaf cells (the move committed, then the
+    # geometry moved on) re-map to the leaf containing the dead cell's
+    # center so the ledger overlay never lands on a cell that isn't
+    # there.
+    for eid, cell in list(flips.items()):
+        if not _live_leaf(cell):
+            tgt = _center_leaf(cell)
+            if tgt is None:
+                del flips[eid]
+            else:
+                flips[eid] = tgt
+    return moved
+
+
 def _reseed_controller(flips: dict[int, int]) -> None:
     """Rebuild the placement ledger + device tracking from the restored
     cell rows (the same discipline as the failover re-host seed), then
@@ -761,6 +924,16 @@ def _reseed_controller(flips: dict[int, int]) -> None:
     tracker = getattr(ctl, "track_entity", None)
     moved_hook = getattr(ctl, "_note_entity_data_moved", None)
     center_of = getattr(ctl, "_cell_center", None)
+    tree = getattr(ctl, "tree", None)
+    if tree is not None:
+        # Geometry-aware: a child cell's id is NOT a base-grid index,
+        # so derive the seed position from the tree's world-space
+        # center instead of ``cid - lo`` arithmetic.
+        from ..spatial.controller import SpatialInfo
+
+        def center_of(idx, _tree=tree, _lo=lo):  # noqa: F811
+            x, z = _tree.center(_lo + idx)
+            return SpatialInfo(x, 0.0, z)
     for cid, ch in list(all_channels().items()):
         if not (lo <= cid < hi) or ch.is_removing():
             continue

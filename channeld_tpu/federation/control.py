@@ -191,6 +191,7 @@ class GlobalControlPlane:
         # peer -> consecutive epochs its reported directory version
         # trailed ours (leader-side; >= 3 triggers a replace re-sync).
         self._behind_streak: dict[str, int] = {}
+        self._geo_behind_streak: dict[str, int] = {}
         # Peers that announced a graceful-shutdown goodbye: the death
         # declaration skips the miss window for them (the silence is
         # intentional, not ambiguous; doc/device_recovery.md).
@@ -445,6 +446,129 @@ class GlobalControlPlane:
             else:
                 self._behind_streak.pop(p, None)
 
+    def _sync_geometry(self, peer: str) -> None:
+        """Full geometry sync to one trunk peer (adaptive partitioning,
+        doc/partitioning.md): the complete split set under the current
+        epoch, idempotently applicable — the receiver keeps its own
+        local-cell splits and adopts ours for the rest."""
+        from ..spatial.controller import get_spatial_controller
+
+        ctl = get_spatial_controller()
+        tree = getattr(ctl, "tree", None) if ctl is not None else None
+        if tree is None:
+            return
+        link = self.plane.link_to(peer)
+        if link is None:
+            return
+        from ..protocol import spatial_pb2
+
+        link.send(
+            MessageType.CELL_GEOMETRY_UPDATE,
+            spatial_pb2.CellGeometryUpdateMessage(
+                geometryEpoch=tree.epoch,
+                splitCells=sorted(tree.splits),
+                op="sync",
+            ),
+        )
+
+    def _reassert_geometry(self) -> None:
+        """Leader anti-entropy over the load-report geometry epochs,
+        mirroring _reassert_directory: a live peer AHEAD of us ran its
+        own splits while partitioned (concurrent leader) — fast-forward
+        past its epoch, merging its view on next sync; a peer trailing
+        BEHIND for several consecutive epochs missed updates — re-sync
+        just that peer."""
+        if self.epoch < self._heal_hold_until:
+            return
+        from ..spatial.controller import get_spatial_controller
+
+        ctl = get_spatial_controller()
+        tree = getattr(ctl, "tree", None) if ctl is not None else None
+        if tree is None:
+            return
+        my_e = tree.epoch
+        ahead = max(
+            (v.get("geometry_epoch") or 0
+             for p, v in self.vectors.items()
+             if p != directory.local_id and p not in self.dead),
+            default=0,
+        )
+        if ahead > my_e:
+            # Keep our split set, fast-forward the epoch so our next
+            # assertion is not rejected fleet-wide as stale.
+            logger.warning(
+                "geometry anti-entropy: a live peer is at epoch %d > "
+                "local %d (partitioned concurrent split) — "
+                "fast-forwarding and re-asserting", ahead, my_e,
+            )
+            ctl.apply_geometry(ahead + 1, tree.splits)
+            for peer in self.live_peers():
+                self._sync_geometry(peer)
+            return
+        for p in self.live_peers():
+            e = self.vectors.get(p, {}).get("geometry_epoch")
+            if e is None:
+                continue
+            if e < my_e:
+                streak = self._geo_behind_streak.get(p, 0) + 1
+                if streak >= 3:
+                    logger.warning(
+                        "geometry anti-entropy: %s stuck at epoch %d < "
+                        "local %d for %d epochs — re-syncing",
+                        p, e, my_e, streak,
+                    )
+                    streak = 0
+                    self._sync_geometry(p)
+                self._geo_behind_streak[p] = streak
+            else:
+                self._geo_behind_streak.pop(p, None)
+
+    def on_geometry_update(self, peer: str, msg) -> None:
+        """A trunk peer asserted its cell geometry. Adopt the remote
+        split set for cells mapped to OTHER gateways; splits under
+        locally-mapped base cells stay exactly as the local partition
+        plane committed them (it is the only authority for them, and a
+        remote view may be an epoch stale)."""
+        from ..spatial.controller import get_spatial_controller
+
+        ctl = get_spatial_controller()
+        tree = getattr(ctl, "tree", None) if ctl is not None else None
+        if tree is None:
+            return
+        epoch = msg.geometryEpoch
+        if epoch <= tree.epoch:
+            return  # stale assertion; our next load report corrects them
+
+        def _local(s: int) -> bool:
+            return directory.is_local_cell(tree.start + tree.base_cell_of(s))
+
+        keep = {s for s in tree.splits if _local(s)}
+        take = set()
+        for s in msg.splitCells:
+            try:
+                if not _local(s):
+                    take.add(s)
+            except ValueError:
+                continue  # undecodable under our depth bound: drop
+        merged = frozenset(keep | take)
+        err = tree.validate_splits(merged)
+        if err is not None:
+            logger.error(
+                "geometry update from %s (epoch %d) merged invalid "
+                "(%s); keeping local epoch %d",
+                peer, epoch, err, tree.epoch,
+            )
+            return
+        ctl.apply_geometry(epoch, merged)
+        from ..core.wal import wal as _wal
+
+        if _wal.enabled:
+            _wal.log_geometry(epoch, merged)
+        logger.info(
+            "geometry update from %s applied: epoch %d, %d split cells "
+            "(%d local kept)", peer, epoch, len(merged), len(keep),
+        )
+
     # ---- the control epoch -----------------------------------------------
 
     async def _epoch_loop(self) -> None:
@@ -477,6 +601,7 @@ class GlobalControlPlane:
         self._check_deaths()
         if self.is_leader():
             self._reassert_directory()
+            self._reassert_geometry()
             self._check_plan_deadlines()
             self._plan()
 
@@ -493,6 +618,16 @@ class GlobalControlPlane:
         st = global_settings
         lo, hi = st.spatial_channel_id_start, st.entity_channel_id_start
         ctl = get_spatial_controller()
+        tree = getattr(ctl, "tree", None) if ctl is not None else None
+        if tree is not None:
+            # Geometry-aware: split children are live cells too, and a
+            # split parent is not (adaptive partitioning).
+            for cid in tree.leaves():
+                ch = get_channel(cid)
+                if ch is not None and not ch.is_removing() \
+                        and directory.is_local_cell(cid):
+                    yield cid, ch
+            return
         n_cells = getattr(ctl, "grid_cols", 0) * getattr(ctl, "grid_rows", 0)
         if n_cells:
             for cid in range(lo, lo + n_cells):
@@ -541,7 +676,16 @@ class GlobalControlPlane:
             "trunk_rtt_ms": round(sum(rtts) / len(rtts), 3) if rtts else 0.0,
             "blocks": blocks,
             "directory_version": directory.override_version,
+            "geometry_epoch": self._geometry_epoch(),
         }
+
+    @staticmethod
+    def _geometry_epoch() -> int:
+        from ..spatial.controller import get_spatial_controller
+
+        ctl = get_spatial_controller()
+        tree = getattr(ctl, "tree", None) if ctl is not None else None
+        return tree.epoch if tree is not None else 0
 
     def _export(self, vector: dict) -> None:
         msg = control_pb2.TrunkLoadReportMessage(
@@ -558,6 +702,7 @@ class GlobalControlPlane:
                 vector["blocks"][i] for i in sorted(vector["blocks"])
             ],
             directoryVersion=vector["directory_version"],
+            geometryEpoch=vector["geometry_epoch"],
         )
         from ..core.slo import slo as _slo
 
@@ -2380,6 +2525,7 @@ class GlobalControlPlane:
                 "trunk_rtt_ms": msg.trunkRttMs,
                 "blocks": dict(zip(msg.blockIndices, msg.blockEntities)),
                 "directory_version": msg.directoryVersion,
+                "geometry_epoch": msg.geometryEpoch,
             }
             if msg.metricsJson:
                 from .obs import fleet

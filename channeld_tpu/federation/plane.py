@@ -944,6 +944,12 @@ class FederationPlane:
                 self._in_global_tick(
                     lambda: global_control.on_directory_update(changed)
                 )
+        elif msg_type == MessageType.CELL_GEOMETRY_UPDATE:
+            # A peer asserted its cell geometry (adaptive partitioning):
+            # channel mutations may follow, so inside the GLOBAL tick.
+            self._in_global_tick(
+                lambda: global_control.on_geometry_update(peer, msg)
+            )
         elif msg_type == MessageType.TRUNK_HELLO:
             pass  # re-hello after establishment: harmless
         elif msg_type == MessageType.TRUNK_HEARTBEAT:

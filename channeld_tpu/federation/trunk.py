@@ -35,7 +35,7 @@ from ..chaos.injector import chaos as _chaos
 from ..core.settings import global_settings
 from ..core.tracing import recorder as _trace
 from ..core.types import MessageType
-from ..protocol import control_pb2, wire_pb2
+from ..protocol import control_pb2, spatial_pb2, wire_pb2
 from ..protocol.framing import FrameDecoder, FramingError, encode_packet
 from ..utils.logger import get_logger
 
@@ -63,6 +63,10 @@ TRUNK_MESSAGES = {
     MessageType.TRUNK_ADOPT_CLAIMS: control_pb2.TrunkAdoptClaimsMessage,
     # Durable persistence plane (core/wal.py; doc/persistence.md).
     MessageType.TRUNK_RESURRECT_HELLO: control_pb2.TrunkResurrectHelloMessage,
+    # Adaptive partitioning geometry sync (spatial/partition.py;
+    # doc/partitioning.md) — the same message engine SDKs receive,
+    # reused peer-to-peer for leader anti-entropy.
+    MessageType.CELL_GEOMETRY_UPDATE: spatial_pb2.CellGeometryUpdateMessage,
 }
 
 

@@ -117,6 +117,10 @@ class SpatialEngine:
         # (one recompile then). The device copy updates by row scatter —
         # H2D is O(changed rows x C), never the whole table.
         self._q_spot_dist: Optional[np.ndarray] = None
+        # World-space spot sources per connection: the dist rows above
+        # are in CELL space, so a grid swap (apply_grid — adaptive
+        # partitioning) must re-rasterize every row from these.
+        self._spot_sources: dict[int, tuple] = {}
         self._d_spot_dist = None  # tpulint: shared=fence
         self._spot_dirty_rows: set[int] = set()  # tpulint: shared=fence
         self._queries_dirty = True  # tpulint: shared=fence
@@ -248,6 +252,7 @@ class SpatialEngine:
         angle: float = 0.0,
     ) -> None:
         q = self._query_slot(conn_id)
+        self._spot_sources.pop(conn_id, None)  # no longer a spots query
         self._q_kind[q] = kind
         self._q_center[q] = center_xz
         self._q_extent[q] = extent_xz
@@ -271,6 +276,10 @@ class SpatialEngine:
         import math
 
         q = self._query_slot(conn_id)
+        self._spot_sources[conn_id] = (
+            [tuple(s) for s in spots_xz],
+            list(dists) if dists is not None else None,
+        )
         if self._q_spot_dist is None:
             self._q_spot_dist = np.full(
                 (self.query_capacity, self.grid.num_cells), -1, np.int32
@@ -299,6 +308,7 @@ class SpatialEngine:
 
     def remove_query(self, conn_id: int) -> None:
         q = self._q_of_conn.pop(conn_id, None)
+        self._spot_sources.pop(conn_id, None)
         if q is not None:
             self._q_kind[q] = AOI_NONE
             if self._q_spot_dist is not None:
@@ -702,6 +712,35 @@ class SpatialEngine:
         self._sub_last_dirty.clear()
         self._flush_host_state()
         self.last_result = None
+
+    def apply_grid(self, grid, slot_cells: dict[int, int],
+                   now_ms: Optional[int] = None,
+                   expect_generation: Optional[int] = None) -> None:
+        """Swap the cell grid and rebuild every grid-shaped device array
+        (adaptive partitioning, doc/partitioning.md: the controller
+        mirrors the cell tree's uniform micro grid onto the device at
+        each geometry epoch). Reuses the supervised-rebuild machinery —
+        the caller passes the same placement-ledger cell baselines
+        (in NEW-grid indices) the crash rebuild uses, the generation
+        fence makes a watchdog-abandoned swap unable to commit, and
+        ``verify_device_state`` afterwards proves the rebuilt arrays
+        bit-identical to the host shadow. Grid-shaped state that cannot
+        be carried over is rebuilt from world-space sources: the spots
+        dist table re-rasterizes from ``_spot_sources``; the compiled
+        (mesh) step re-traces lazily on the next tick."""
+        self.grid = grid
+        # The grid is baked into the compiled mesh step: force a
+        # re-build/re-trace on the next tick.
+        self._mesh_step = None
+        # Spots rows are [Q, num_cells] in cell space: drop both copies
+        # and re-rasterize every row against the new grid.
+        self._q_spot_dist = None
+        self._d_spot_dist = None
+        self._spot_dirty_rows.clear()
+        for conn_id, (spots, dists) in list(self._spot_sources.items()):
+            self.set_spots_query(conn_id, spots, dists)
+        self.rebuild_device_state(slot_cells, now_ms=now_ms,
+                                  expect_generation=expect_generation)
 
     def verify_device_state(self, slot_cells: dict[int, int]) -> list[str]:
         """Bit-identical rebuild verification: fetch the just-rebuilt

@@ -291,6 +291,12 @@ class BalancerPlane:
 
     def _plan(self, ctl, loads, cell_stats) -> None:
         st = global_settings
+        if self.frozen_cells:
+            # Another plane (the adaptive-partitioning transaction,
+            # doc/partitioning.md) holds the crossing freeze: planning a
+            # migration now would clobber its frozen set on commit.
+            # Transient — re-plan once the geometry op resolves.
+            return
         if _governor.level >= OverloadLevel.L2:
             # Never fight the overload ladder: shedding outranks
             # rebalancing, and a migration is extra load by definition.
@@ -355,6 +361,48 @@ class BalancerPlane:
             "%.2f); crossings frozen, draining journal",
             self._migration_seq, cell_id, hottest.id, dst.id, self.imbalance,
         )
+
+    def plan_directed(self, cell_id: int, dst_conn, reason: str = "") -> bool:
+        """Directed migration on behalf of another control plane — the
+        adaptive-partitioning governor reuniting a cold sibling group's
+        diverged owners before a merge (doc/partitioning.md). The SAME
+        transaction (freeze -> drain -> flip, same ledger/metric) with
+        the candidate/hysteresis/cooldown policy left to the caller;
+        only the hard safety guards stay: one migration at a time, no
+        clobbering a held crossing freeze, never at overload L2+, never
+        to a dead or identical destination. Advances even while
+        autonomous balancing is disabled (``update`` drains an in-flight
+        migration before consulting ``balancer_enabled``)."""
+        from ..core.channel import get_channel
+
+        if self._migration is not None or self.frozen_cells:
+            return False
+        if _governor.level >= OverloadLevel.L2:
+            return False
+        ch = get_channel(cell_id)
+        if ch is None or ch.is_removing() or not ch.has_owner():
+            return False
+        src = ch.get_owner()
+        if dst_conn is None or dst_conn is src or dst_conn.is_closing():
+            return False
+        self._migration_seq += 1
+        self._migration = CellMigration(
+            migration_id=self._migration_seq,
+            cell_id=cell_id,
+            src_conn=src,
+            dst_conn=dst_conn,
+            planned_tick=self._tick,
+            epoch=self._epoch,
+        )
+        self.frozen_cells = frozenset((cell_id,))
+        self._count("planned")
+        logger.info(
+            "migration %d planned (directed%s): cell %d, server %d -> %d; "
+            "crossings frozen, draining journal",
+            self._migration_seq, f": {reason}" if reason else "",
+            cell_id, src.id, dst_conn.id,
+        )
+        return True
 
     # ---- the in-flight transaction ---------------------------------------
 

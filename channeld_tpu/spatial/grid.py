@@ -2,11 +2,20 @@
 
 Capability parity with the reference controller
 (ref: pkg/channeld/spatial.go:89-902): the world is GridCols x GridRows
-cells on the XZ plane; channelId = spatial_start + x + y*cols; each
-spatial server owns a ServerCols x ServerRows block plus an interest
-border of cells it subscribes to; AOI queries (spots/box/sphere/cone)
-sample cells at half-grid steps and return {channelId: grid-distance};
-``notify`` orchestrates cross-cell (and cross-server) entity handover.
+base cells on the XZ plane; each spatial server owns a ServerCols x
+ServerRows block plus an interest border of cells it subscribes to; AOI
+queries (spots/box/sphere/cone) sample cells at half-cell steps and
+return {channelId: grid-distance}; ``notify`` orchestrates cross-cell
+(and cross-server) entity handover.
+
+Cell geometry is a runtime, versioned property (doc/partitioning.md):
+all channel-id, adjacency and server-placement math consults the live
+:class:`~.celltree.CellTree`, which the adaptive partitioning plane
+(spatial/partition.py) mutates through transactional geometry epochs.
+With no splits active the tree reproduces the legacy static formulas
+bit-for-bit — geometry tests pin the INVARIANTS (position->leaf
+containment, neighbor-band adjacency, server inheritance), not one
+fixed layout.
 
 This module is the *semantic reference* path. The TPU decision plane
 (channeld_tpu.ops / tpu_controller.py) computes cell assignment, AOI
@@ -23,6 +32,8 @@ from ..core.overload import governor as _governor
 from ..core.settings import global_settings
 from ..federation.directory import directory as _shard_directory
 from .balancer import balancer as _balancer
+from .celltree import CellTree
+from .partition import partition as _partition
 from ..core.types import ChannelType, ConnectionType, MessageType
 from ..protocol import control_pb2, spatial_pb2
 from ..utils.anyutil import pack_any
@@ -56,6 +67,10 @@ class StaticGrid2DSpatialController:
         self.server_interest_border_size = 0
         self.server_connections: list = []
         self._grid_size = 0.0
+        # Live cell geometry (doc/partitioning.md): built at load_config,
+        # mutated only through apply_geometry (the partition plane's
+        # commit, trunk geometry sync, and WAL replay).
+        self.tree: Optional[CellTree] = None
         # Authoritative placement ledger: entity id -> the spatial cell
         # channel whose DATA currently holds the entity. Crossing
         # detection works from positions (host) or the device prev-cell
@@ -88,6 +103,22 @@ class StaticGrid2DSpatialController:
             raise ValueError("GridCols and GridRows should be positive")
         if self.server_cols <= 0 or self.server_rows <= 0:
             raise ValueError("ServerCols and ServerRows should be positive")
+        st = global_settings
+        self.tree = CellTree(
+            st.spatial_channel_id_start, self.grid_cols, self.grid_rows,
+            self.grid_width, self.grid_height,
+            self.world_offset_x, self.world_offset_z,
+            max_depth=st.partition_max_depth,
+        )
+        # Id-space guard: every depth's cell block must fit under the
+        # entity channel id space, or a deep split would mint ids that
+        # collide with entity channels.
+        if self.tree.id_space_end() > st.entity_channel_id_start:
+            raise ValueError(
+                f"partition_max_depth={st.partition_max_depth} needs cell "
+                f"ids up to {self.tree.id_space_end()}, past the entity "
+                f"id start {st.entity_channel_id_start}"
+            )
         from ..core import events
 
         def _on_channel_removed(channel_id: int) -> None:
@@ -134,17 +165,50 @@ class StaticGrid2DSpatialController:
     def get_channel_id_with_offset(
         self, info: SpatialInfo, offset_x: float, offset_z: float
     ) -> int:
-        """channelId = start + floor((x-ox)/w) + floor((z-oz)/h)*cols
-        (ref: spatial.go:169-180). Raises ValueError outside the world."""
+        """Position -> LIVE LEAF cell id. Base cell by the legacy
+        formula start + floor((x-ox)/w) + floor((z-oz)/h)*cols
+        (ref: spatial.go:169-180), then descended through any active
+        splits. Raises ValueError outside the world."""
         gx = math.floor((info.x - offset_x) / self.grid_width)
         if gx < 0 or gx >= self.grid_cols:
             raise ValueError(f"gridX={gx} out of [0,{self.grid_cols}) for X={info.x}")
         gz = math.floor((info.z - offset_z) / self.grid_height)
         if gz < 0 or gz >= self.grid_rows:
             raise ValueError(f"gridY={gz} out of [0,{self.grid_rows}) for Z={info.z}")
+        cell = global_settings.spatial_channel_id_start + gx + gz * self.grid_cols
+        tree = self.tree
+        if tree is None or not tree.splits:
+            return cell
+        rx, rz = info.x - offset_x, info.z - offset_z
+        d = 0
+        while cell in tree.splits:
+            d += 1
+            w = self.grid_width / (1 << d)
+            h = self.grid_height / (1 << d)
+            cgx = min(int(rx // w), (self.grid_cols << d) - 1)
+            cgz = min(int(rz // h), (self.grid_rows << d) - 1)
+            cell = tree.encode(d, cgx, cgz)
+        return cell
+
+    def base_cell_id(self, gx: int, gz: int) -> int:
+        """Depth-0 (base-grid) cell id; raises outside the grid."""
+        if gx < 0 or gx >= self.grid_cols:
+            raise ValueError(f"gridX={gx} out of [0,{self.grid_cols})")
+        if gz < 0 or gz >= self.grid_rows:
+            raise ValueError(f"gridY={gz} out of [0,{self.grid_rows})")
         return global_settings.spatial_channel_id_start + gx + gz * self.grid_cols
 
     # ---- AOI queries -----------------------------------------------------
+
+    def _sample_cell_size(self) -> tuple[float, float]:
+        """AOI sampling granularity: the finest live cell (the micro
+        grid's), so a box/sphere sweep cannot step over a split child.
+        Equals the base cell size when no splits are active."""
+        tree = self.tree
+        if tree is None or not tree.splits:
+            return self.grid_width, self.grid_height
+        d = tree.max_active_depth()
+        return self.grid_width / (1 << d), self.grid_height / (1 << d)
 
     def query_channel_ids(self, query: spatial_pb2.SpatialInterestQuery) -> dict[int, int]:
         """{channelId: distance in grid-diagonal units}; 0 = nearest
@@ -152,6 +216,7 @@ class StaticGrid2DSpatialController:
         if query is None:
             raise ValueError("query is nil")
         result: dict[int, int] = {}
+        samp_w, samp_h = self._sample_cell_size()
 
         if query.HasField("spotsAOI"):
             for i, spot in enumerate(query.spotsAOI.spots):
@@ -167,10 +232,10 @@ class StaticGrid2DSpatialController:
         if query.HasField("boxAOI"):
             box = query.boxAOI
             cx, cz = box.center.x, box.center.z
-            step_z = min(box.extent.z, self.grid_height) * 0.5
+            step_z = min(box.extent.z, samp_h) * 0.5
             if step_z <= 0:
                 raise ValueError(f"invalid box extentZ={box.extent.z}")
-            step_x = min(box.extent.x, self.grid_width) * 0.5
+            step_x = min(box.extent.x, samp_w) * 0.5
             if step_x <= 0:
                 raise ValueError(f"invalid box extentX={box.extent.x}")
             z = cz - box.extent.z
@@ -185,8 +250,8 @@ class StaticGrid2DSpatialController:
         if query.HasField("sphereAOI"):
             r = query.sphereAOI.radius
             cx, cz = query.sphereAOI.center.x, query.sphereAOI.center.z
-            step_z = min(r, self.grid_height) * 0.5
-            step_x = min(r, self.grid_width) * 0.5
+            step_z = min(r, samp_h) * 0.5
+            step_x = min(r, samp_w) * 0.5
             if step_z <= 0 or step_x <= 0:
                 raise ValueError(f"invalid radius={r}")
             z = cz - r
@@ -207,8 +272,8 @@ class StaticGrid2DSpatialController:
             dlen = math.hypot(dx, dz)
             if dlen > 0:
                 dx, dz = dx / dlen, dz / dlen
-            step_z = min(r, self.grid_height) * 0.5
-            step_x = min(r, self.grid_width) * 0.5
+            step_z = min(r, samp_h) * 0.5
+            step_x = min(r, samp_w) * 0.5
             if step_z <= 0 or step_x <= 0:
                 raise ValueError(f"invalid radius={r}")
             cos_angle = math.cos(cone.angle)
@@ -248,9 +313,26 @@ class StaticGrid2DSpatialController:
         return -(-self.grid_rows // self.server_rows)
 
     def get_regions(self) -> list[spatial_pb2.SpatialRegion]:
-        """(ref: spatial.go:319-356)."""
+        """One region per LIVE LEAF cell (ref: spatial.go:319-356);
+        identical to the legacy base-grid sweep when no splits are
+        active (leaves come back in base row-major order)."""
         sgc, sgr = self._server_grid_cols(), self._server_grid_rows()
+        tree = self.tree
         regions = []
+        if tree is not None:
+            for leaf in tree.leaves():
+                x0, z0, x1, z1 = tree.rect(leaf)
+                regions.append(
+                    spatial_pb2.SpatialRegion(
+                        min=spatial_pb2.SpatialInfo(x=x0, y=MIN_Y, z=z0),
+                        max=spatial_pb2.SpatialInfo(x=x1, y=MAX_Y, z=z1),
+                        channelId=leaf,
+                        serverIndex=tree.server_index_of(
+                            leaf, sgc, sgr, self.server_cols
+                        ),
+                    )
+                )
+            return regions
         for y in range(self.grid_rows):
             for x in range(self.grid_cols):
                 index = x + y * self.grid_cols
@@ -275,18 +357,35 @@ class StaticGrid2DSpatialController:
     def server_index_of_cell(self, spatial_channel_id: int) -> int:
         """The spatial-server index whose authority block contains the
         cell — the same geometric mapping get_regions stamps into
-        ``SpatialRegion.serverIndex``. The shard directory
-        (federation/directory.py) resolves cell->gateway through this.
-        Raises ValueError outside the grid."""
+        ``SpatialRegion.serverIndex``. Child cells inherit their base
+        cell's server (a split never moves authority across servers by
+        itself). The shard directory (federation/directory.py) resolves
+        cell->gateway through this. Raises ValueError outside the
+        geometry's id space."""
+        sgc, sgr = self._server_grid_cols(), self._server_grid_rows()
+        tree = self.tree
+        if tree is not None:
+            try:
+                return tree.server_index_of(
+                    spatial_channel_id, sgc, sgr, self.server_cols
+                )
+            except ValueError:
+                raise ValueError(
+                    f"channel {spatial_channel_id} outside the grid"
+                )
         index = spatial_channel_id - global_settings.spatial_channel_id_start
         if index < 0 or index >= self.grid_cols * self.grid_rows:
             raise ValueError(f"channel {spatial_channel_id} outside the grid")
         gx, gy = index % self.grid_cols, index // self.grid_cols
-        sgc, sgr = self._server_grid_cols(), self._server_grid_rows()
         return (gx // sgc) + (gy // sgr) * self.server_cols
 
     def get_adjacent_channels(self, spatial_channel_id: int) -> list[int]:
-        """3x3 neighborhood minus self (ref: spatial.go:358-381)."""
+        """Live leaves within one BASE cell of the given cell, minus
+        itself — exactly the legacy 3x3 neighborhood when no splits are
+        active (ref: spatial.go:358-381)."""
+        tree = self.tree
+        if tree is not None:
+            return tree.neighbor_leaves(spatial_channel_id)
         index = spatial_channel_id - global_settings.spatial_channel_id_start
         gx, gy = index % self.grid_cols, index // self.grid_cols
         out = []
@@ -350,19 +449,33 @@ class StaticGrid2DSpatialController:
         channel_ids = []
         for y in range(sgr):
             for x in range(sgc):
-                info = SpatialInfo(
-                    x=(sx * sgc + x) * self.grid_width,
-                    z=(sy * sgr + y) * self.grid_height,
-                )
-                channel_ids.append(self.get_channel_id_no_offset(info))
+                base = self.base_cell_id(sx * sgc + x, sy * sgr + y)
+                # A geometry restored BEFORE the servers registered (WAL
+                # replay) may already have this base cell split: the
+                # server's block is its live leaves, not the base ids.
+                if self.tree is not None:
+                    channel_ids.extend(self.tree.leaves_under(base))
+                else:
+                    channel_ids.append(base)
+
+        from ..core.channel import get_channel
 
         channels = []
         for channel_id in channel_ids:
-            ch = create_channel_with_id(channel_id, ChannelType.SPATIAL, ctx.connection)
-            if msg.HasField("data"):
-                ch.init_data(unwrap_update_any(msg.data), msg.mergeOptions)
-            else:
-                ch.init_data(None, msg.mergeOptions)
+            # Boot replay can have restored the leaf channel (with its
+            # authoritative data) ahead of the owning server's
+            # registration — adopt it instead of re-creating.
+            ch = get_channel(channel_id)
+            if ch is None or ch.is_removing():
+                ch = create_channel_with_id(
+                    channel_id, ChannelType.SPATIAL, ctx.connection
+                )
+                if msg.HasField("data"):
+                    ch.init_data(unwrap_update_any(msg.data), msg.mergeOptions)
+                else:
+                    ch.init_data(None, msg.mergeOptions)
+            elif not ch.has_owner():
+                ch.set_owner(ctx.connection)
             channels.append(ch)
 
         self.server_connections[server_index] = ctx.connection
@@ -413,21 +526,27 @@ class StaticGrid2DSpatialController:
         border = self.server_interest_border_size
 
         def sub_cell(grid_x_units: float, grid_z_units: float) -> None:
-            info = SpatialInfo(
-                x=grid_x_units * self.grid_width, z=grid_z_units * self.grid_height
+            base = self.base_cell_id(int(grid_x_units), int(grid_z_units))
+            # Border interest covers every live leaf under the base
+            # cell — a split border cell contributes all its children.
+            leaves = (
+                self.tree.leaves_under(base)
+                if self.tree is not None else [base]
             )
-            channel_id = self.get_channel_id_no_offset(info)
-            ch = get_channel(channel_id)
-            if ch is None:
-                if not _shard_directory.is_local_cell(channel_id):
-                    # Border cell in a remote shard: it has no local
-                    # channel to subscribe to. Cross-gateway interest
-                    # arrives as handover/redirect traffic instead.
-                    return
-                raise RuntimeError(f"border channel {channel_id} doesn't exist")
-            cs, should_send = subscribe_to_channel(conn, ch, sub_options)
-            if should_send:
-                send_subscribed(conn, ch, conn, 0, cs.options)
+            for channel_id in leaves:
+                ch = get_channel(channel_id)
+                if ch is None:
+                    if not _shard_directory.is_local_cell(channel_id):
+                        # Border cell in a remote shard: it has no local
+                        # channel to subscribe to. Cross-gateway interest
+                        # arrives as handover/redirect traffic instead.
+                        continue
+                    raise RuntimeError(
+                        f"border channel {channel_id} doesn't exist"
+                    )
+                cs, should_send = subscribe_to_channel(conn, ch, sub_options)
+                if should_send:
+                    send_subscribed(conn, ch, conn, 0, cs.options)
 
         if sx > 0:  # cells to the left of the block
             for y in range(sgr):
@@ -448,7 +567,8 @@ class StaticGrid2DSpatialController:
 
     def tick(self) -> None:
         """Reap closed server connections (ref: spatial.go:884-893), then
-        run the load-balancer update (doc/balancer.md) — both inside the
+        run the load-balancer update (doc/balancer.md) and the adaptive
+        partitioning governor (doc/partitioning.md) — all inside the
         GLOBAL channel tick, the single-writer context every channel
         mutation here requires."""
         self._init_server_connections()
@@ -457,6 +577,44 @@ class StaticGrid2DSpatialController:
                 self.server_connections[i] = None
                 logger.info("reset spatial server connection %d", i)
         _balancer.update(self)
+        _partition.update(self)
+
+    # ---- live geometry (doc/partitioning.md) -----------------------------
+
+    @property
+    def geometry_epoch(self) -> int:
+        return self.tree.epoch if self.tree is not None else 0
+
+    def geometry_splits(self) -> frozenset:
+        return self.tree.splits if self.tree is not None else frozenset()
+
+    def apply_geometry(self, epoch: int, splits) -> None:
+        """Replace the live cell geometry wholesale. The ONLY mutation
+        path — used by the partition plane's commit/abort, trunk
+        geometry sync (federation/control.py) and WAL replay. Validates
+        the split set, bumps the epoch gauge, refreshes the per-leaf
+        depth gauges and invokes the device-rebuild hook."""
+        if self.tree is None:
+            raise RuntimeError("geometry applied before load_config")
+        from ..core import metrics
+
+        old_leaves = set(self.tree.leaves())
+        self.tree.apply(epoch, splits)
+        metrics.partition_geometry_epoch.set(epoch)
+        new_leaves = set(self.tree.leaves())
+        for cell in old_leaves - new_leaves:
+            metrics.spatial_cell_depth.labels(cell=str(cell)).set(0)
+        for cell in new_leaves:
+            metrics.spatial_cell_depth.labels(cell=str(cell)).set(
+                self.tree.depth_of(cell)
+            )
+        self.on_geometry_changed()
+
+    def on_geometry_changed(self) -> None:
+        """Hook for the device plane (tpu_controller overrides): rebuild
+        interest masks and cell-id arrays for the new geometry epoch.
+        The host-semantics controller needs nothing — every lookup
+        already consults the live tree."""
 
     # ---- handover --------------------------------------------------------
 
@@ -538,6 +696,15 @@ class StaticGrid2DSpatialController:
             return
         self._orchestrate_pair(src_channel_id, dst_channel_id,
                                [handover_data_provider])
+
+    def entity_position(self, entity_id: int):
+        """Last known world position of one tracked entity, or None when
+        the controller keeps no position cache (host-semantics mode).
+        The partition plane uses this to sort residents into child
+        quadrants at split commit; with no position the entity
+        bootstraps into the child containing the parent's center and
+        re-sorts on its next movement."""
+        return None
 
     def _note_entity_data_moved(self, entity_ids, dst_channel_id: int) -> None:
         """Placement-ledger callback: fires only when entity data

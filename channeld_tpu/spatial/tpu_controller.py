@@ -79,6 +79,18 @@ class TPUSpatialController(StaticGrid2DSpatialController):
         self._follow_skip = False
         # _data_cell: inherited — the placement ledger lives on the
         # base grid controller (host gateways need the same exactness).
+        # Device micro grid (adaptive partitioning, doc/partitioning.md):
+        # the engine always serves a UNIFORM grid — the cell tree's
+        # micro grid at its deepest active split. Device cell indices
+        # are micro indices; ``_micro_leaf`` maps each back to the leaf
+        # channel that owns it. With no splits the micro grid IS the
+        # base grid and the mapping is identity — the legacy path
+        # bit-for-bit.
+        self._mcols = 0
+        self._mrows = 0
+        self._mw = 0.0
+        self._mh = 0.0
+        self._micro_leaf: Optional[list[int]] = None
 
     def load_config(self, config: dict) -> None:
         super().load_config(config)
@@ -107,14 +119,15 @@ class TPUSpatialController(StaticGrid2DSpatialController):
         # space-partitioned plane (all_to_all redistribution + column-block
         # AOI + ring halos); default "entities" is the psum plane. Only
         # meaningful with a mesh.
+        self._refresh_micro()
         self.engine = SpatialEngine(
             GridSpec(
                 offset_x=self.world_offset_x,
                 offset_z=self.world_offset_z,
-                cell_w=self.grid_width,
-                cell_h=self.grid_height,
-                cols=self.grid_cols,
-                rows=self.grid_rows,
+                cell_w=self._mw,
+                cell_h=self._mh,
+                cols=self._mcols,
+                rows=self._mrows,
             ),
             entity_capacity=global_settings.tpu_entity_capacity,
             query_capacity=global_settings.tpu_query_capacity,
@@ -170,11 +183,7 @@ class TPUSpatialController(StaticGrid2DSpatialController):
                 )
                 return
             try:
-                old_cell = (
-                    self.get_channel_id(old_info)
-                    - global_settings.spatial_channel_id_start
-                )
-                self.engine.seed_cell(slot, old_cell)
+                self.engine.seed_cell(slot, self._micro_index(old_info))
             except ValueError:
                 pass  # old position outside the world: no baseline
         try:
@@ -215,9 +224,7 @@ class TPUSpatialController(StaticGrid2DSpatialController):
         if slot is None:
             return
         try:
-            cell = (self.get_channel_id(info)
-                    - global_settings.spatial_channel_id_start)
-            self.engine.seed_cell(slot, cell)
+            self.engine.seed_cell(slot, self._micro_index(info))
         except ValueError:
             pass  # outside the world: no baseline
 
@@ -270,6 +277,132 @@ class TPUSpatialController(StaticGrid2DSpatialController):
     # placement ledger lives on the base grid controller now (host
     # gateways need the same exactness; doc/global_control.md).
 
+    def entity_position(self, entity_id: int):
+        """Partition-plane hook: the split commit sorts residents into
+        child quadrants by last known position (None -> deterministic
+        center-child fallback)."""
+        info = self._last_positions.get(entity_id)
+        return (info.x, info.z) if info is not None else None
+
+    # ---- device micro grid (adaptive partitioning) -----------------------
+
+    def _refresh_micro(self) -> None:
+        """Recompute the micro grid spec + micro->leaf map from the cell
+        tree. Depth 0 (or no tree) degenerates to the base grid with an
+        identity mapping."""
+        tree = getattr(self, "tree", None)
+        if tree is None:
+            self._mcols, self._mrows = self.grid_cols, self.grid_rows
+            self._mw, self._mh = self.grid_width, self.grid_height
+            self._micro_leaf = None
+            return
+        _d, mcols, mrows, mw, mh = tree.micro_spec()
+        self._mcols, self._mrows = mcols, mrows
+        self._mw, self._mh = mw, mh
+        self._micro_leaf = tree.micro_to_leaf() if tree.splits else None
+
+    def _micro_index(self, info) -> int:
+        """Device (micro) cell index of a world position; ValueError
+        outside the grid. Divide-then-floor, matching the device's
+        assign_cells exactly — these values feed device baselines."""
+        import math
+
+        col = math.floor((info.x - self.world_offset_x) / self._mw)
+        row = math.floor((info.z - self.world_offset_z) / self._mh)
+        if not (0 <= col < self._mcols and 0 <= row < self._mrows):
+            raise ValueError("position outside the grid")
+        return row * self._mcols + col
+
+    def _leaf_of_cell(self, cell: int) -> int:
+        """Leaf channel id owning one device micro cell."""
+        if self._micro_leaf is not None and 0 <= cell < len(self._micro_leaf):
+            return self._micro_leaf[cell]
+        return global_settings.spatial_channel_id_start + cell
+
+    def _micro_of_channel(self, ch_id: int, entity_id: int = None) -> int:
+        """Device baseline micro cell for an entity whose data lives in
+        ``ch_id``: the micro cell of its last position when that still
+        lies inside the leaf, else the leaf's center micro cell."""
+        tree = getattr(self, "tree", None)
+        if tree is None or self._micro_leaf is None:
+            return ch_id - global_settings.spatial_channel_id_start
+        if entity_id is not None:
+            info = self._last_positions.get(entity_id)
+            if info is not None:
+                try:
+                    m = self._micro_index(info)
+                    if self._leaf_of_cell(m) == ch_id:
+                        return m
+                except ValueError:
+                    pass
+        try:
+            x, z = tree.center(ch_id)
+        except ValueError:
+            return -1
+        return self._micro_index(SpatialInfo(x, 0, z))
+
+    def _channel_center(self, ch_id: int) -> SpatialInfo:
+        """World-space center of one spatial CHANNEL (any depth)."""
+        tree = getattr(self, "tree", None)
+        if tree is not None:
+            x, z = tree.center(ch_id)
+            return SpatialInfo(x, 0, z)
+        return self._cell_center(
+            ch_id - global_settings.spatial_channel_id_start
+        )
+
+    def on_geometry_changed(self) -> None:
+        """A geometry epoch committed (spatial/partition.py apply path or
+        WAL/snapshot restore): re-mirror the cell tree onto the device.
+        A same-depth change only swaps the host-side micro->leaf map; a
+        depth change rebuilds the device arrays onto the new micro grid
+        through the supervised-rebuild machinery (generation-fenced
+        against watchdog-abandoned steps) and verifies the rebuilt
+        arrays bit-identical to the host shadow."""
+        old = (self._mcols, self._mrows)
+        self._refresh_micro()
+        if self.engine is None:
+            return
+        if (self._mcols, self._mrows) == old:
+            return  # same micro grid; only the leaf mapping moved
+        from ..core import metrics
+        from ..ops.spatial_ops import GridSpec
+
+        seeds = self.rebuild_seed_cells()
+        self.engine.apply_grid(
+            GridSpec(
+                offset_x=self.world_offset_x,
+                offset_z=self.world_offset_z,
+                cell_w=self._mw,
+                cell_h=self._mh,
+                cols=self._mcols,
+                rows=self._mrows,
+            ),
+            seeds,
+        )
+        errors = self.engine.verify_device_state(seeds)
+        metrics.partition_device_rebuilds.labels(
+            result="verified" if not errors else "mismatch"
+        ).inc()
+        if errors:
+            logger.error(
+                "geometry epoch %d device rebuild NOT bit-identical: %s",
+                self.geometry_epoch, "; ".join(errors),
+            )
+            if _trace.enabled:
+                _trace.note_anomaly(
+                    "geometry_rebuild_mismatch",
+                    f"epoch {self.geometry_epoch}: " + "; ".join(errors),
+                    force=True,
+                )
+        else:
+            logger.info(
+                "geometry epoch %d: device micro grid now %dx%d "
+                "(%.3gx%.3g cells), rebuild verified bit-identical",
+                self.geometry_epoch, self._mcols, self._mrows,
+                self._mw, self._mh,
+            )
+
     # ---- device supervision hooks (core/device_guard.py) -----------------
 
     def on_device_fatal(self, cause: str) -> None:
@@ -296,8 +429,10 @@ class TPUSpatialController(StaticGrid2DSpatialController):
         bound for the pending dst); entities with neither fall back to
         their last known position (first sighting that never
         orchestrated). The rebuilt engine re-detects any movement since
-        from these baselines, so an outage never loses a crossing."""
-        start = global_settings.spatial_channel_id_start
+        from these baselines, so an outage never loses a crossing.
+
+        Cell indices are MICRO-grid indices (identical to base-grid
+        indices until a split is live; doc/partitioning.md)."""
         seeds: dict[int, int] = {}
         for entity_id, slot in self.engine.tracked_entities():
             ch_id = _journal.pending_dst(entity_id)
@@ -310,7 +445,10 @@ class TPUSpatialController(StaticGrid2DSpatialController):
                         ch_id = self.get_channel_id(info)
                     except ValueError:
                         ch_id = None
-            seeds[slot] = (ch_id - start) if ch_id is not None else -1
+            seeds[slot] = (
+                self._micro_of_channel(ch_id, entity_id)
+                if ch_id is not None else -1
+            )
         return seeds
 
     # ---- device fan-out plane --------------------------------------------
@@ -462,10 +600,18 @@ class TPUSpatialController(StaticGrid2DSpatialController):
             if entry is None:
                 continue
             desired = desired_all.get(conn_id, {})
-            apply_interest_diff(
-                entry["conn"],
-                {start + cell: dist for cell, dist in desired.items()},
-            )
+            if self._micro_leaf is None:
+                wanted = {start + cell: dist for cell, dist in desired.items()}
+            else:
+                # Micro cells collapse onto leaf CHANNELS; several micro
+                # cells of one leaf -> keep the closest distance (interest
+                # priority is distance-ranked).
+                wanted = {}
+                for cell, dist in desired.items():
+                    ch = self._leaf_of_cell(cell)
+                    if ch not in wanted or dist < wanted[ch]:
+                        wanted[ch] = dist
+            apply_interest_diff(entry["conn"], wanted)
 
     def tick(self) -> None:
         super().tick()  # reap closed server connections
@@ -534,9 +680,15 @@ class TPUSpatialController(StaticGrid2DSpatialController):
             # detects ~1.5K crossings per tick and per-crossing host
             # orchestration measured 3.9x slower than the detection rate
             # (scripts/bench_handover.py).
-            start_id = global_settings.spatial_channel_id_start
             pending = self._deferred_crossings
             for e, s, d in handovers:
+                if (self._micro_leaf is not None
+                        and self._leaf_of_cell(s) == self._leaf_of_cell(d)):
+                    # Intra-leaf micro crossing: the device grid is finer
+                    # than the channel geometry here (an unsplit neighbor
+                    # pins the micro depth); no channel boundary crossed,
+                    # nothing to orchestrate.
+                    continue
                 prev = pending.get(e)
                 if prev is not None:
                     # Chain: the entity's data still lives where the
@@ -554,25 +706,25 @@ class TPUSpatialController(StaticGrid2DSpatialController):
                 # (it only flips on commit, in the dst cell's tick).
                 pend_dst = _journal.pending_dst(e)
                 if pend_dst is not None:
-                    if pend_dst == start_id + d:
+                    if pend_dst == self._leaf_of_cell(d):
                         # Stale re-detection of the in-flight move.
                         continue
                     # Chained hop: orchestrate from where the in-flight
                     # txn will land (FIFO on that channel's queue puts
                     # the new remove after the pending add).
                     pending[e] = (
-                        self._cell_center(pend_dst - start_id),
+                        self._channel_center(pend_dst),
                         new_info, provider,
                     )
                     continue
                 known = self._data_cell.get(e)
                 if known is not None:
-                    if known == start_id + d:
+                    if known == self._leaf_of_cell(d):
                         # Stale re-detection (cells-plane re-offer): the
                         # data already lives in the destination.
                         continue
-                    if known != start_id + s:
-                        old_info = self._cell_center(known - start_id)
+                    if known != self._leaf_of_cell(s):
+                        old_info = self._channel_center(known)
                 pending[e] = (old_info, new_info, provider)
             cap = _governor.handover_batch_cap()
             if cap is None and len(pending) > len(handovers):
@@ -632,8 +784,7 @@ class TPUSpatialController(StaticGrid2DSpatialController):
         old_info = self._prev_positions.get(entity_id)
         if old_info is not None:
             try:
-                mapped = (self.get_channel_id(old_info)
-                          - global_settings.spatial_channel_id_start)
+                mapped = self._micro_index(old_info)
             except ValueError:
                 mapped = -1
             if mapped != src_cell:
@@ -653,8 +804,9 @@ class TPUSpatialController(StaticGrid2DSpatialController):
         StaticGrid2DSpatialController.notify(self, old_info, new_info, provider)
 
     def _cell_center(self, cell: int) -> SpatialInfo:
-        x = self.world_offset_x + (cell % self.grid_cols + 0.5) * self.grid_width
-        z = self.world_offset_z + (cell // self.grid_cols + 0.5) * self.grid_height
+        # MICRO-grid center (== base grid until a split is live).
+        x = self.world_offset_x + (cell % self._mcols + 0.5) * self._mw
+        z = self.world_offset_z + (cell // self._mcols + 0.5) * self._mh
         return SpatialInfo(x, 0, z)
 
 

@@ -143,6 +143,10 @@ async def run_abuse_soak(p: AbuseSoakParams) -> dict:
     # Side planes pinned OFF: this soak's envelope is the edge plane's
     # (each plane has its own soak; see their docs).
     global_settings.balancer_enabled = False
+    # Adaptive partitioning stays pinned OFF: this soak's envelope
+    # assumes the static boot grid (doc/partitioning.md);
+    # scripts/density_soak.py is the partitioning plane's own soak.
+    global_settings.partition_enabled = False
     global_settings.device_guard_enabled = False
     global_settings.slo_enabled = False
     global_settings.trace_enabled = False

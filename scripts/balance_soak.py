@@ -213,6 +213,11 @@ async def run_balance_soak(p: BalanceSoakParams) -> dict:
     )
     global_settings.failover_enabled = True
     global_settings.balancer_enabled = True
+    # Adaptive partitioning stays pinned OFF: this soak PROVES the
+    # fixed-grid 1.31 floor the density soak then beats
+    # (doc/partitioning.md) — a live split here would invalidate
+    # the envelope.
+    global_settings.partition_enabled = False
     # Federation stays pinned OFF: a remote shard would route some
     # crossings over a trunk and break this soak's deterministic
     # single-gateway accounting (doc/federation.md).

@@ -494,6 +494,10 @@ async def run_soak(p: SoakParams) -> dict:
     # This soak proves the CHAOS plane: the balancer's planned migrations
     # would add nondeterministic authority moves to a seeded scenario.
     global_settings.balancer_enabled = False
+    # Adaptive partitioning stays pinned OFF: this soak's envelope
+    # assumes the static boot grid (doc/partitioning.md);
+    # scripts/density_soak.py is the partitioning plane's own soak.
+    global_settings.partition_enabled = False
     # Flight recorder pinned OFF (doc/observability.md): these soaks
     # prove deterministic accounting and timing envelopes; span
     # recording and anomaly auto-dumps must not perturb either

@@ -84,6 +84,14 @@ SCHEMAS: dict[str, set] = {
     "SOAK_ABUSE_*.json": _SOAK_KEYS | {
         "attackers", "edge", "census", "delivery", "rss",
     },
+    # Adaptive-partitioning density soak (doc/partitioning.md
+    # acceptance artifact): the geometry ledgers, the kill-mid-split
+    # record, the steady-state density fold, the final geometry, and
+    # the device rebuild verification counts.
+    "SOAK_SPLIT_*.json": _SOAK_KEYS | {
+        "partition", "balancer", "kill", "steady_state",
+        "final_geometry", "device_rebuilds", "journal",
+    },
 }
 
 
@@ -275,12 +283,68 @@ def _check_abuse_soak(doc: dict) -> list[str]:
     return errors
 
 
+def _check_density_soak(doc: dict) -> list[str]:
+    """The density soak's acceptance bar beyond key presence
+    (doc/partitioning.md): at least one committed LIVE split with the
+    steady per-server max/mean flattened below the 1.31 fixed-grid
+    floor, exactly-once placement, partition_ops_total == the python
+    ledger, the injected kill aborted deterministically (geometry epoch
+    untouched) with the re-planned split committing after failover,
+    cold merges restoring the boot geometry, and every device
+    micro-grid rebuild verified bit-identical (zero mismatches)."""
+    errors: list[str] = []
+    names = {
+        c.get("name") for c in doc.get("invariants", {}).get("checks", [])
+    }
+    for required in (
+        "no_geometry_op_while_uniform",
+        "pileup_split_committed",
+        "steady_density_ratio_below_fixed_grid_floor",
+        "partition_metric_matches_ledger",
+        "kill_mid_split_aborts_deterministically",
+        "split_recommits_after_failover",
+        "geometry_restored_after_disperse",
+        "device_rebuilds_zero_mismatch",
+        "every_entity_in_exactly_one_cell",
+        "journal_prepared_equals_committed_plus_aborted",
+    ):
+        if required not in names:
+            errors.append(f"missing invariant check {required!r}")
+    steady = doc.get("steady_state", {})
+    ratio = steady.get("density_ratio")
+    if ratio is None or ratio > 1.31:
+        errors.append(
+            f"steady density ratio not under the 1.31 fixed-grid floor "
+            f"({ratio})"
+        )
+    if not steady.get("max_depth"):
+        errors.append("no live split depth recorded at steady state")
+    ledger = doc.get("partition", {}).get("ledger", {})
+    if not ledger.get("split_committed"):
+        errors.append(f"no committed live split (ledger={ledger})")
+    if not ledger.get("merge_committed"):
+        errors.append(f"no committed cold merge (ledger={ledger})")
+    if doc.get("final_geometry", {}).get("splits"):
+        errors.append(
+            f"boot geometry not restored: {doc['final_geometry']}"
+        )
+    kill = doc.get("kill") or {}
+    if not (kill.get("aborted") and kill.get("epoch_unchanged_by_abort")
+            and kill.get("recommitted_after_failover")):
+        errors.append(f"kill-mid-split record not clean: {kill}")
+    rebuilds = doc.get("device_rebuilds", {})
+    if rebuilds.get("mismatch") != 0 or not rebuilds.get("verified"):
+        errors.append(f"device rebuild verification not clean: {rebuilds}")
+    return errors
+
+
 EXTRA_CHECKS = {
     "SOAK_GLOBAL_*.json": _check_global_soak,
     "SOAK_DEVICE_*.json": _check_device_soak,
     "SOAK_CRASH_*.json": _check_crash_soak,
     "OBS_*.json": _check_obs_soak,
     "SOAK_ABUSE_*.json": _check_abuse_soak,
+    "SOAK_SPLIT_*.json": _check_density_soak,
 }
 
 
@@ -507,9 +571,49 @@ def check_concurrency_doc(repo: str = REPO) -> list[str]:
     return errors
 
 
+def check_partitioning_doc(repo: str = REPO) -> list[str]:
+    """doc/partitioning.md must document every ``partition_*`` operator
+    knob core/settings.py declares (a knob added without doc — or
+    documented after removal — is drift), and the docs whose planes the
+    geometry epochs ride must cross-link it: README, doc/balancer.md
+    (shared freeze/migration machinery), doc/global_control.md
+    (geometry anti-entropy), doc/persistence.md (WAL geometry records
+    + replay re-homing)."""
+    path = os.path.join(repo, "doc", "partitioning.md")
+    if not os.path.exists(path):
+        return ["doc/partitioning.md missing (adaptive-partitioning "
+                "operator reference)"]
+    text = open(path).read()
+    errors: list[str] = []
+    settings_src = open(
+        os.path.join(repo, "channeld_tpu", "core", "settings.py")
+    ).read()
+    declared = set(re.findall(r"^    (partition_[a-z0-9_]+):",
+                              settings_src, re.M))
+    documented = set(re.findall(r"`(partition_[a-z0-9_]+)`", text))
+    for name in sorted(declared - documented):
+        errors.append(
+            f"doc/partitioning.md: knob {name!r} is declared in "
+            "core/settings.py but not documented"
+        )
+    for name in sorted(documented - declared):
+        errors.append(
+            f"doc/partitioning.md: documents knob {name!r} with no "
+            "matching declaration in core/settings.py"
+        )
+    for rel in ("README.md", "doc/balancer.md", "doc/global_control.md",
+                "doc/persistence.md"):
+        linked = os.path.join(repo, rel)
+        if not os.path.exists(linked) \
+                or "partitioning.md" not in open(linked).read():
+            errors.append(f"{rel}: no cross-link to doc/partitioning.md")
+    return errors
+
+
 def main() -> int:
     errors = (check_artifacts() + check_doc_metrics()
-              + check_artifact_metrics() + check_concurrency_doc())
+              + check_artifact_metrics() + check_concurrency_doc()
+              + check_partitioning_doc())
     if errors:
         for e in errors:
             print(f"DRIFT: {e}")

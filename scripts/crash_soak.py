@@ -133,6 +133,9 @@ def wal_settings_hook(gw_id: str, state_dir: str, p: CrashSoakParams):
         gs.global_death_miss_epochs = p.death_miss_epochs
         gs.global_min_entity_delta = 10_000  # no rebalancing noise
         gs.failover_enabled = True
+        # Adaptive partitioning stays pinned OFF: this soak's
+        # envelope assumes the static boot grid (doc/partitioning.md).
+        gs.partition_enabled = False
         gs.snapshot_path = snap_path
         gs.snapshot_interval_s = p.snapshot_interval_s
         gs.wal_path = wal_path

@@ -192,6 +192,10 @@ async def run_soak(p: SoakParams) -> dict:
     # the exercise); the balancer/federation/tracing planes are pinned
     # off to keep the envelope deterministic, like every other soak.
     global_settings.balancer_enabled = False
+    # Adaptive partitioning stays pinned OFF: this soak's envelope
+    # assumes the static boot grid (doc/partitioning.md);
+    # scripts/density_soak.py is the partitioning plane's own soak.
+    global_settings.partition_enabled = False
     global_settings.trace_enabled = False
     # SLO plane pinned OFF (doc/observability.md): this soak's
     # envelope predates the delivery-latency sampling; the health

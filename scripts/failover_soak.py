@@ -223,6 +223,10 @@ async def run_failover_soak(p: FailoverSoakParams) -> dict:
     # re-host accounting must see only CRASH-path authority moves
     # (scripts/balance_soak.py proves the planned-migration path).
     global_settings.balancer_enabled = False
+    # Adaptive partitioning stays pinned OFF: this soak's envelope
+    # assumes the static boot grid (doc/partitioning.md);
+    # scripts/density_soak.py is the partitioning plane's own soak.
+    global_settings.partition_enabled = False
     # Federation stays pinned OFF: a remote shard would route some
     # crossings over a trunk and break this soak's deterministic
     # single-gateway accounting (doc/federation.md).

@@ -144,6 +144,10 @@ async def bench_config(name: str, spec: dict, entities: int,
 
     global_settings.development = True
     global_settings.balancer_enabled = False
+    # Adaptive partitioning stays pinned OFF: this soak's envelope
+    # assumes the static boot grid (doc/partitioning.md);
+    # scripts/density_soak.py is the partitioning plane's own soak.
+    global_settings.partition_enabled = False
     global_settings.tpu_entity_capacity = max(1 << 10, 1 << (
         max(entities - 1, 1).bit_length() + 1))
     global_settings.tpu_query_capacity = 64

@@ -260,6 +260,10 @@ async def boot_gateway(gw_id: str, fed_cfg: dict, params: FedSoakParams,
     # nondeterministic authority moves (L3 is driven explicitly in the
     # refusal phase instead).
     global_settings.balancer_enabled = False
+    # Adaptive partitioning stays pinned OFF: this soak's envelope
+    # assumes the static boot grid (doc/partitioning.md);
+    # scripts/density_soak.py is the partitioning plane's own soak.
+    global_settings.partition_enabled = False
     # Device guard pinned OFF (doc/device_recovery.md): this soak's
     # envelope is deterministic; the watchdog worker-thread hop and
     # any chaos-adjacent retry would perturb it. The device plane's

@@ -153,6 +153,9 @@ def _settings_hook(p: GlobalSoakParams):
         gs.global_migrate_timeout_ms = 8000
         gs.global_adopt_claims_timeout_ms = 800
         gs.failover_enabled = True
+        # Adaptive partitioning stays pinned OFF: this soak's
+        # envelope assumes the static boot grid (doc/partitioning.md).
+        gs.partition_enabled = False
 
     return hook
 

@@ -233,6 +233,10 @@ async def run_live_phase(p: ObsSoakParams, dump_dir: str) -> dict:
 
     global_settings.development = True
     global_settings.balancer_enabled = False
+    # Adaptive partitioning stays pinned OFF: this soak's envelope
+    # assumes the static boot grid (doc/partitioning.md);
+    # scripts/density_soak.py is the partitioning plane's own soak.
+    global_settings.partition_enabled = False
     # The guard is enabled so /readyz reads a real DeviceState, but no
     # device faults are injected here — the state is driven directly
     # for the flip check (the guard REACHING these states under real

@@ -201,6 +201,10 @@ async def run_overload_soak(p: OverloadSoakParams) -> dict:
     # at L2+ anyway, but pinning it off keeps the saturation timeline
     # free of planned authority moves (scripts/balance_soak.py owns that).
     global_settings.balancer_enabled = False
+    # Adaptive partitioning stays pinned OFF: this soak's envelope
+    # assumes the static boot grid (doc/partitioning.md);
+    # scripts/density_soak.py is the partitioning plane's own soak.
+    global_settings.partition_enabled = False
     # Device guard pinned OFF (doc/device_recovery.md): this soak's
     # envelope is deterministic; the watchdog worker-thread hop and
     # any chaos-adjacent retry would perturb it. The device plane's

@@ -82,6 +82,9 @@ def _fresh_globals(tmp_path):
     settings.reset_global_settings()
     overload.reset_overload()
     balancer_mod.reset_balancer()
+    from channeld_tpu.spatial import partition as partition_mod
+
+    partition_mod.reset_partition()
     device_guard.reset_device_guard()
     tracing.reset_tracing()
     wal_mod.reset_wal()

@@ -290,6 +290,118 @@ def test_obs_soak_schema_gate(tmp_path):
                for e in check_artifacts.check_artifacts(str(tmp_path)))
 
 
+def _density_soak_doc():
+    return {
+        "kind": "density_soak",
+        "invariants": {"ok": True, "checks": [
+            {"name": n, "ok": True} for n in (
+                "no_geometry_op_while_uniform",
+                "pileup_split_committed",
+                "steady_density_ratio_below_fixed_grid_floor",
+                "partition_metric_matches_ledger",
+                "kill_mid_split_aborts_deterministically",
+                "split_recommits_after_failover",
+                "geometry_restored_after_disperse",
+                "device_rebuilds_zero_mismatch",
+                "every_entity_in_exactly_one_cell",
+                "journal_prepared_equals_committed_plus_aborted",
+            )
+        ]},
+        "partition": {"ledger": {"split_committed": 2, "split_aborted": 1,
+                                 "merge_committed": 2}},
+        "balancer": {}, "journal": {},
+        "kill": {"aborted": True, "epoch_unchanged_by_abort": True,
+                 "recommitted_after_failover": True},
+        "steady_state": {"density_ratio": 1.09, "max_depth": 1},
+        "final_geometry": {"epoch": 4, "splits": []},
+        "device_rebuilds": {"verified": 2, "mismatch": 0},
+    }
+
+
+def test_density_soak_schema_gate(tmp_path):
+    """SOAK_SPLIT_*.json extra checks (doc/partitioning.md): a clean
+    artifact passes; a density ratio at/over the 1.31 fixed-grid
+    floor, a missing committed split, unrestored boot geometry, a
+    dirty kill record, a device-rebuild mismatch, and a missing
+    invariant name are each flagged."""
+    import json
+
+    path = tmp_path / "SOAK_SPLIT_r99.json"
+    path.write_text(json.dumps(_density_soak_doc()))
+    assert check_artifacts.check_artifacts(str(tmp_path)) == []
+
+    doc = _density_soak_doc()
+    doc["steady_state"]["density_ratio"] = 1.45
+    path.write_text(json.dumps(doc))
+    assert any("1.31 fixed-grid floor" in e
+               for e in check_artifacts.check_artifacts(str(tmp_path)))
+
+    doc = _density_soak_doc()
+    doc["partition"]["ledger"]["split_committed"] = 0
+    path.write_text(json.dumps(doc))
+    assert any("no committed live split" in e
+               for e in check_artifacts.check_artifacts(str(tmp_path)))
+
+    doc = _density_soak_doc()
+    doc["final_geometry"]["splits"] = [65541]
+    path.write_text(json.dumps(doc))
+    assert any("boot geometry not restored" in e
+               for e in check_artifacts.check_artifacts(str(tmp_path)))
+
+    doc = _density_soak_doc()
+    doc["kill"]["epoch_unchanged_by_abort"] = False
+    path.write_text(json.dumps(doc))
+    assert any("kill-mid-split record not clean" in e
+               for e in check_artifacts.check_artifacts(str(tmp_path)))
+
+    doc = _density_soak_doc()
+    doc["device_rebuilds"]["mismatch"] = 1
+    path.write_text(json.dumps(doc))
+    assert any("device rebuild verification not clean" in e
+               for e in check_artifacts.check_artifacts(str(tmp_path)))
+
+    doc = _density_soak_doc()
+    doc["invariants"]["checks"] = [
+        c for c in doc["invariants"]["checks"]
+        if c["name"] != "split_recommits_after_failover"
+    ]
+    path.write_text(json.dumps(doc))
+    assert any("missing invariant check 'split_recommits_after_failover'"
+               in e
+               for e in check_artifacts.check_artifacts(str(tmp_path)))
+
+
+def test_partitioning_doc_matches_declared_knobs():
+    """doc/partitioning.md documents exactly the partition_* knobs
+    core/settings.py declares, and the planes the geometry epochs ride
+    (README, balancer, global control, persistence) cross-link it."""
+    assert check_artifacts.check_partitioning_doc() == []
+
+
+def test_partitioning_doc_drift_is_flagged(tmp_path):
+    import shutil
+
+    doc_dir = tmp_path / "doc"
+    doc_dir.mkdir()
+    core = tmp_path / "channeld_tpu" / "core"
+    core.mkdir(parents=True)
+    shutil.copy(os.path.join(REPO, "channeld_tpu", "core", "settings.py"),
+                core / "settings.py")
+
+    errors = check_artifacts.check_partitioning_doc(str(tmp_path))
+    assert errors and "missing" in errors[0]
+
+    (doc_dir / "partitioning.md").write_text(
+        "# x\n\n`partition_enabled` and the phantom `partition_ghost_knob`.\n"
+    )
+    errors = check_artifacts.check_partitioning_doc(str(tmp_path))
+    # Every undeclared documented knob + every undocumented declared
+    # knob + all four missing cross-links are flagged.
+    assert any("partition_ghost_knob" in e for e in errors)
+    assert any("partition_max_depth" in e for e in errors)
+    assert sum("no cross-link" in e for e in errors) == 4
+
+
 def test_artifact_metric_refs_are_checked():
     """Committed artifacts citing metrics must cite registered families
     with the declared label sets (scripts/check_artifacts.py

@@ -1,0 +1,730 @@
+"""Adaptive partitioning (spatial/partition.py; doc/partitioning.md).
+
+Live quadtree cell split/merge as transactional geometry epochs riding
+the override-version + migration machinery: the density governor plans
+splits of hot cells and merges of cold sibling groups; each op freezes
+crossings, drains the handover journal, writes ONE WAL geometry record
+(the commit point), repartitions resident entities through the
+transactional journal with a CellGeometryUpdateMessage bootstrap, and
+unfreezes — or aborts deterministically with the old geometry intact.
+
+The interaction matrix here covers split/merge x the in-flight journal
+x WAL replay x the balancer's migration plane, abort-on-owner-death,
+the overload/depth vetoes (with the forced ``density_hotspot`` dump),
+and the concurrent-leader geometry race (federation anti-entropy).
+"""
+
+import asyncio
+import importlib.util
+import os
+import sys
+
+import pytest
+
+from channeld_tpu.core import connection as connection_mod
+from channeld_tpu.core import metrics
+from channeld_tpu.core.channel import (
+    all_channels,
+    get_channel,
+    get_global_channel,
+)
+from channeld_tpu.core.connection import add_connection
+from channeld_tpu.core.failover import journal
+from channeld_tpu.core.fsm import MessageFsm
+from channeld_tpu.core.message import MessageContext
+from channeld_tpu.core.overload import OverloadLevel, governor
+from channeld_tpu.core.settings import global_settings
+from channeld_tpu.core.tracing import recorder
+from channeld_tpu.core.types import ConnectionType, MessageType
+from channeld_tpu.core.wal import boot_replay, reset_wal, wal
+from channeld_tpu.federation.directory import directory
+from channeld_tpu.models import sim_pb2
+from channeld_tpu.models.sim import register_sim_types
+from channeld_tpu.protocol import (
+    FrameDecoder,
+    control_pb2,
+    encode_packet,
+    spatial_pb2,
+    wire_pb2,
+)
+from channeld_tpu.spatial.balancer import balancer
+from channeld_tpu.spatial.controller import (
+    SpatialInfo,
+    set_spatial_controller,
+)
+from channeld_tpu.spatial.grid import StaticGrid2DSpatialController
+from channeld_tpu.spatial.partition import partition
+
+from helpers import FakeTransport, fresh_runtime
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+AUTH_FSM = {
+    "States": [
+        {"Name": "INIT", "MsgTypeWhitelist": "1", "MsgTypeBlacklist": ""},
+        {"Name": "OPEN", "MsgTypeWhitelist": "2-65535", "MsgTypeBlacklist": ""},
+    ],
+    "Transitions": [],
+}
+
+
+@pytest.fixture(autouse=True)
+def runtime():
+    gch = fresh_runtime()
+    register_sim_types()
+    reset_wal()
+    global_settings.development = True
+    global_settings.server_conn_recoverable = True
+    connection_mod.set_fsm_templates(
+        MessageFsm.from_dict(AUTH_FSM), MessageFsm.from_dict(AUTH_FSM)
+    )
+    yield gch
+    directory.reset()
+    reset_wal()
+
+
+def wire(msg_type, msg, ch=0):
+    return encode_packet(wire_pb2.Packet(messages=[wire_pb2.MessagePack(
+        channelId=ch, msgType=msg_type, msgBody=msg.SerializeToString()
+    )]))
+
+
+def sent_messages(t):
+    dec = FrameDecoder()
+    out = []
+    for chunk in t.written:
+        for p in dec.decode_packets(chunk):
+            out.extend(p.messages)
+    return out
+
+
+def auth_server(pit):
+    t = FakeTransport()
+    conn = add_connection(t, ConnectionType.SERVER)
+    conn.on_bytes(wire(MessageType.AUTH, control_pb2.AuthMessage(
+        playerIdentifierToken=pit)))
+    get_global_channel().tick_once(0)
+    return conn, t
+
+
+def auth_client(pit):
+    t = FakeTransport()
+    conn = add_connection(t, ConnectionType.CLIENT)
+    conn.on_bytes(wire(MessageType.AUTH, control_pb2.AuthMessage(
+        playerIdentifierToken=pit)))
+    get_global_channel().tick_once(0)
+    return conn, t
+
+
+def bare_ctl(cols=4, server_cols=1):
+    """Controller + tree only (no channels) — the restart-replay shape."""
+    ctl = StaticGrid2DSpatialController()
+    ctl.load_config(dict(
+        WorldOffsetX=0, WorldOffsetZ=0, GridWidth=100, GridHeight=100,
+        GridCols=cols, GridRows=1, ServerCols=server_cols, ServerRows=1,
+        ServerInterestBorderSize=0,
+    ))
+    set_spatial_controller(ctl)
+    return ctl
+
+
+def make_grid(cols=4, servers=None):
+    """A 1-row host-grid world; each server claims cols/len(servers)
+    cells, with sim-typed channel data (has an entity table)."""
+    ctl = bare_ctl(cols, server_cols=len(servers))
+    cells = []
+    for server in servers:
+        chs = ctl.create_channels(MessageContext(
+            msg_type=MessageType.CREATE_CHANNEL,
+            msg=control_pb2.CreateChannelMessage(),
+            connection=server,
+        ))
+        for ch in chs:
+            ch.init_data(sim_pb2.SimSpatialChannelData(), None)
+            from channeld_tpu.core.subscription import subscribe_to_channel
+
+            subscribe_to_channel(server, ch, None)
+        cells.extend(chs)
+    return ctl, cells
+
+
+def fill_entities(ctl, cell, positions, base=0x80100):
+    """Add entities to ``cell`` at given (x, z) world positions; wires
+    ``ctl.entity_position`` (the split's quadrant sorter) to them."""
+    book = getattr(ctl, "_test_positions", None)
+    if book is None:
+        book = ctl._test_positions = {}
+        ctl.entity_position = lambda eid: book.get(eid)
+    eids = []
+    for i, (x, z) in enumerate(positions):
+        eid = base + i
+        d = sim_pb2.SimEntityChannelData()
+        d.state.entityId = eid
+        cell.get_data_message().add_entity(eid, d)
+        book[eid] = (x, z)
+        eids.append(eid)
+    return eids
+
+
+def tune_partition(**over):
+    """Small-world-friendly knobs."""
+    st = global_settings
+    st.partition_enabled = True
+    st.partition_eval_ticks = over.pop("eval", 1)
+    st.partition_hold_ticks = over.pop("hold", 1)
+    st.partition_freeze_min_ticks = over.pop("freeze_min", 0)
+    st.partition_split_entities = over.pop("split", 10)
+    st.partition_merge_entities = over.pop("merge", 4)
+    st.partition_epoch_ticks = over.pop("epoch_ticks", 100000)
+    st.partition_drain_deadline_ticks = over.pop("drain_deadline", 30)
+    st.partition_cooldown_ticks = over.pop("cooldown", 0)
+    st.partition_budget_per_epoch = over.pop("budget", 8)
+    for k, v in over.items():
+        setattr(st, f"partition_{k}", v)
+
+
+def pump(n=1):
+    """One GLOBAL tick (governor evaluation + op advance) then drain
+    every channel FIFO (the queued repartition moves / teardowns)."""
+    gch = get_global_channel()
+    for _ in range(n):
+        gch.tick_once(0)
+        for ch in list(all_channels().values()):
+            if ch is not gch and not ch.is_removing():
+                ch.tick_once(ch.get_time())
+
+
+def spatial_entity_map():
+    """entity id -> [channel ids holding it] across live spatial cells."""
+    lo = global_settings.spatial_channel_id_start
+    hi = global_settings.entity_channel_id_start
+    out = {}
+    for cid, ch in all_channels().items():
+        if lo <= cid < hi and not ch.is_removing():
+            for eid in (getattr(ch.get_data_message(), "entities", None)
+                        or {}):
+                out.setdefault(eid, []).append(cid)
+    return out
+
+
+def quadrant_positions():
+    """12 positions in cell 0 (rect 0..100 x 0..100): 2/2/3/5 per
+    quadrant — enough to cross a split threshold of 10."""
+    return ([(10, 10), (30, 20)] +            # child (0,0)
+            [(60, 10), (90, 40)] +            # child (1,0)
+            [(20, 60), (10, 90), (40, 70)] +  # child (0,1)
+            [(60, 60), (70, 80), (90, 90), (55, 55), (99, 99)])  # (1,1)
+
+
+# ---- the split transaction -------------------------------------------------
+
+
+def test_hot_cell_splits_zero_loss_with_bootstrap():
+    """Tentpole core: a cell past the split threshold splits into its
+    four quadrant children under the same owner — entities repartitioned
+    by position through the transactional journal (zero loss/dup), the
+    geometry epoch bumped, the owner bootstrapped with packed state and
+    a watching client forced to a full resync."""
+    sa, ta = auth_server("pt-a")
+    ctl, cells = make_grid(4, [sa])
+    hot = cells[0]
+    eids = fill_entities(ctl, hot, quadrant_positions())
+
+    watcher, tw = auth_client("pt-w")
+    from channeld_tpu.core.subscription import subscribe_to_channel
+
+    subscribe_to_channel(watcher, hot, None)
+    wcs = hot.subscribed_connections[watcher]
+    wcs.fanout_conn.had_first_fanout = True  # past its first full state
+
+    tune_partition()
+    hot_id = hot.id
+    children = ctl.tree.children(hot_id)
+    for _ in range(30):
+        pump()
+        if partition.ledger.get("split_committed"):
+            break
+    assert partition.ledger.get("split_planned") == 1
+    assert partition.ledger.get("split_committed") == 1
+    assert ctl.tree.epoch == 1 and ctl.tree.splits == {hot_id}
+    # The stale parent is gone; the four children are live, same owner.
+    assert get_channel(hot_id) is None
+    for c in children:
+        assert get_channel(c) is not None
+        assert get_channel(c).get_owner() is sa
+    # Zero-loss, zero-dup, quadrant-exact placement.
+    placed = spatial_entity_map()
+    assert sorted(placed) == sorted(eids)
+    assert all(len(v) == 1 for v in placed.values())
+    counts = [sum(1 for v in placed.values() if v[0] == c)
+              for c in children]
+    assert counts == [2, 2, 3, 5]
+    # Crossing freeze released back to the balancer plane.
+    assert not balancer.frozen_cells
+    # Metric mirrors the python ledger exactly (double-entry guard).
+    for key, n in partition.ledger.items():
+        op, result = key.rsplit("_", 1)
+        assert metrics.partition_ops.labels(
+            op=op, result=result)._value.get() == n
+    # Owner bootstrap: packed authoritative state per child; watcher got
+    # the identifier-only copy and was reset for a full resync.
+    sa.flush()
+    watcher.flush()
+    boots = [m for m in sent_messages(ta)
+             if m.msgType == MessageType.CELL_GEOMETRY_UPDATE]
+    assert len(boots) == 4
+    seen_children = set()
+    for m in boots:
+        g = spatial_pb2.CellGeometryUpdateMessage()
+        g.ParseFromString(m.msgBody)
+        assert g.op == "split"
+        assert g.geometryEpoch == 1
+        assert g.parentChannelId == hot_id
+        assert list(g.splitCells) == [hot_id]
+        assert g.HasField("channelData")
+        data = sim_pb2.SimSpatialChannelData()
+        g.channelData.Unpack(data)
+        assert len(data.entities) == len(g.entityIds)
+        seen_children.add(g.channelId)
+    assert seen_children == set(children)
+    notes = [m for m in sent_messages(tw)
+             if m.msgType == MessageType.CELL_GEOMETRY_UPDATE]
+    assert len(notes) == 4
+    g = spatial_pb2.CellGeometryUpdateMessage()
+    g.ParseFromString(notes[0].msgBody)
+    assert not g.HasField("channelData")  # identifier-only for watchers
+
+
+def test_cold_siblings_merge_back():
+    """The reverse arc: after the crowd disperses, the fully-leaf cold
+    sibling group merges back into the parent — union of subscribers,
+    zero entity loss, geometry restored to depth 0."""
+    sa, _ = auth_server("pm-a")
+    ctl, cells = make_grid(4, [sa])
+    hot = cells[0]
+    hot_id = hot.id
+    eids = fill_entities(ctl, hot, quadrant_positions())
+    tune_partition()
+    for _ in range(30):
+        pump()
+        if partition.ledger.get("split_committed"):
+            break
+    assert ctl.tree.splits == {hot_id}
+
+    # Disperse: drop residents below the merge threshold.
+    children = ctl.tree.children(hot_id)
+    kept = []
+    for c in children:
+        ch = get_channel(c)
+        ents = dict(ch.get_data_message().entities)
+        for eid in list(ents)[1:]:  # keep at most one per child
+            ch.get_data_message().remove_entity(eid)
+        kept.extend(list(ents)[:1])
+    for _ in range(40):
+        pump()
+        if partition.ledger.get("merge_committed"):
+            break
+    assert partition.ledger.get("merge_committed") == 1
+    assert ctl.tree.epoch == 2 and ctl.tree.splits == frozenset()
+    assert get_channel(hot_id) is not None
+    for c in children:
+        assert get_channel(c) is None
+    placed = spatial_entity_map()
+    assert sorted(placed) == sorted(kept)
+    assert all(v == [hot_id] for v in placed.values())
+    assert not balancer.frozen_cells
+
+
+# ---- vetoes ---------------------------------------------------------------
+
+
+def test_split_vetoed_at_overload_l2_dumps_hotspot():
+    """The overload ladder outranks repartitioning: at L2+ a hot cell is
+    vetoed (never planned) AND the flight recorder force-dumps a
+    ``density_hotspot`` anomaly — the operator's timeline for density
+    that has no remedy until the veto lifts."""
+    sa, _ = auth_server("pv-a")
+    ctl, cells = make_grid(4, [sa])
+    fill_entities(ctl, cells[0], quadrant_positions())
+    tune_partition()
+    governor.level = OverloadLevel.L2
+    try:
+        pump(3)
+    finally:
+        governor.level = OverloadLevel.L0
+    assert partition.ledger.get("split_vetoed", 0) >= 1
+    assert "split_planned" not in partition.ledger
+    assert ctl.tree.epoch == 0
+    assert any(a["trigger"] == "density_hotspot" for a in recorder.anomalies)
+
+
+def test_depth_bound_vetoes_split():
+    """A leaf at partition_max_depth never splits further."""
+    sa, _ = auth_server("pd-a")
+    ctl, cells = make_grid(4, [sa])
+    fill_entities(ctl, cells[0], quadrant_positions())
+    tune_partition()
+    global_settings.partition_max_depth = 0  # every leaf at the bound
+    pump(3)
+    assert partition.ledger.get("split_vetoed", 0) >= 1
+    assert "split_planned" not in partition.ledger
+    assert ctl.tree.epoch == 0
+
+
+# ---- x the in-flight handover journal -------------------------------------
+
+
+def test_inflight_journal_blocks_commit_then_commits():
+    """The drain phase: a prepared-but-uncommitted journal record
+    touching the hot cell parks the op in DRAINING; the moment the
+    journal clears, the split commits."""
+    sa, _ = auth_server("pj-a")
+    ctl, cells = make_grid(4, [sa])
+    hot = cells[0]
+    fill_entities(ctl, hot, quadrant_positions())
+    tune_partition(freeze_min=0, drain_deadline=100)
+    recs = journal.prepare({0x90001: None}, hot.id, cells[1].id)
+    pump(5)
+    op = partition.op_in_flight()
+    assert op is not None and op.state == "draining"
+    assert ctl.tree.epoch == 0  # nothing mutated while draining
+    for r in recs:
+        journal.abort(r)
+    for _ in range(30):
+        pump()
+        if partition.ledger.get("split_committed"):
+            break
+    assert partition.ledger.get("split_committed") == 1
+    assert ctl.tree.epoch == 1
+
+
+def test_drain_timeout_aborts_deterministically():
+    """A journal that never clears aborts the op at the drain deadline:
+    geometry unchanged, crossings unfrozen, the abort double-entried and
+    a ``partition_abort`` anomaly noted."""
+    sa, _ = auth_server("pt-t")
+    ctl, cells = make_grid(4, [sa])
+    hot = cells[0]
+    eids = fill_entities(ctl, hot, quadrant_positions())
+    tune_partition(drain_deadline=5)
+    recs = journal.prepare({0x90001: None}, hot.id, cells[1].id)
+    for _ in range(20):
+        pump()
+        if partition.ledger.get("split_aborted"):
+            break
+    assert partition.ledger.get("split_aborted") == 1
+    assert ctl.tree.epoch == 0 and ctl.tree.splits == frozenset()
+    assert get_channel(hot.id) is hot  # the cell never moved
+    assert sorted(spatial_entity_map()) == sorted(eids)
+    assert not balancer.frozen_cells
+    assert partition.events[-1]["reason"] == "drain_timeout"
+    assert any(a["trigger"] == "partition_abort" for a in recorder.anomalies)
+    for r in recs:
+        journal.abort(r)
+
+
+def test_abort_on_owner_death_mid_drain():
+    """The server that would own the new cells dies mid-drain: the
+    packed-state bootstrap has no recipient — deterministic abort
+    (``dst_dead``), failover re-hosts, the governor re-plans later."""
+    sa, _ = auth_server("pk-a")
+    ctl, cells = make_grid(4, [sa])
+    hot = cells[0]
+    fill_entities(ctl, hot, quadrant_positions())
+    # A journal hold keeps the op in DRAINING across the kill.
+    recs = journal.prepare({0x90001: None}, hot.id, cells[1].id)
+    tune_partition(drain_deadline=100)
+    pump(3)
+    assert partition.op_in_flight() is not None
+    sa.close()  # owner socket dies
+    for _ in range(10):
+        pump()
+        if partition.ledger.get("split_aborted"):
+            break
+    assert partition.ledger.get("split_aborted") == 1
+    assert ctl.tree.epoch == 0
+    assert partition.events[-1]["reason"] in ("dst_dead", "owner_diverged",
+                                              "cell_removed")
+    assert not balancer.frozen_cells
+    for r in recs:
+        journal.abort(r)
+
+
+# ---- x the balancer's migration plane -------------------------------------
+
+
+def test_balancer_migration_blocks_partition_planning():
+    """Mutual exclusion, side 1: with a balancer migration in flight the
+    governor arms but never plans (the two planes share the crossing
+    freeze)."""
+    sa, _ = auth_server("pb-a")
+    ctl, cells = make_grid(4, [sa])
+    fill_entities(ctl, cells[0], quadrant_positions())
+    tune_partition()
+    balancer._migration = object()   # any in-flight marker...
+    balancer.update = lambda ctl: None  # ...the balancer itself idles
+    try:
+        pump(5)
+        assert "split_planned" not in partition.ledger
+        assert partition.op_in_flight() is None
+    finally:
+        balancer._migration = None
+        del balancer.update
+    pump(2)
+    assert partition.ledger.get("split_planned") == 1
+
+
+def test_partition_freeze_blocks_balancer_frozen_set():
+    """Mutual exclusion, side 2: a planned geometry op holds the shared
+    frozen-cell set — the balancer defers to it (balancer.update refuses
+    to plan while frozen_cells is non-empty) and the freeze lifts only
+    at the op's terminal state."""
+    sa, _ = auth_server("pf-a")
+    ctl, cells = make_grid(4, [sa])
+    hot = cells[0]
+    fill_entities(ctl, hot, quadrant_positions())
+    tune_partition(freeze_min=1000)  # hold the op open
+    pump(3)
+    assert partition.op_in_flight() is not None
+    assert balancer.frozen_cells == frozenset((hot.id,))
+
+
+def test_diverged_owners_consolidate_then_merge():
+    """A cold sibling group scattered across servers (the balancer
+    placed the split's granules) cannot merge directly: the governor
+    plans DIRECTED balancer migrations reuniting the group on its
+    majority owner (ties break to the lowest conn id), then the merge
+    rides normally and the boot geometry is restored."""
+    from channeld_tpu.core.subscription import subscribe_to_channel
+
+    sa, _ = auth_server("cons-a")
+    sb, _ = auth_server("cons-b")
+    ctl, cells = make_grid(4, [sa, sb])
+    hot = cells[0]
+    fill_entities(ctl, hot, quadrant_positions())
+    tune_partition()
+    # No autonomous balancing in this test: only the governor's
+    # directed consolidations may move authority.
+    global_settings.balancer_enabled = False
+    global_settings.balancer_freeze_min_ticks = 0
+    pump(8)
+    assert ctl.tree.epoch == 1 and set(ctl.tree.splits) == {hot.id}
+    children = ctl.tree.children(hot.id)
+
+    # Scatter two children to server B (as the balancer would) and let
+    # the crowd leave (group total under the merge threshold).
+    for c in children[:2]:
+        ch = get_channel(c)
+        ch.set_owner(sb)
+        subscribe_to_channel(sb, ch, None)
+    for c in children:
+        dm = get_channel(c).get_data_message()
+        for eid in list(dm.entities):
+            dm.remove_entity(eid)
+
+    pump(30)
+    # Both outliers came home through the balancer's own transaction
+    # (full accounting), with no autonomous planning in the mix
+    # (balancer_enabled stays False — directed plans still advance).
+    assert balancer.ledger.get("planned", 0) == 2
+    assert balancer.ledger.get("committed", 0) == 2
+    directed = [e for e in balancer.events if e["result"] == "committed"]
+    assert {e["cell"] for e in directed} == set(children[:2])
+    assert all(e["to"] == sa.id for e in directed)
+    # ...and the merge then restored the boot geometry on one owner.
+    assert partition.ledger.get("merge_committed", 0) == 1
+    assert ctl.tree.epoch == 2 and not ctl.tree.splits
+    parent_ch = get_channel(hot.id)
+    assert parent_ch is not None and parent_ch.get_owner() is sa
+    assert all(get_channel(c) is None for c in children)
+
+
+# ---- x WAL replay (kill -9) ------------------------------------------------
+
+
+def test_wal_replay_restores_committed_geometry(tmp_path):
+    """kill -9 AFTER a committed split: boot replay folds the geometry
+    record, applies the tree, and lands every entity in exactly one
+    live leaf — the parent stays gone."""
+    global_settings.wal_fsync_ms = 1.0
+    path = str(tmp_path / "gw.wal")
+    wal.start(path)
+    sa, _ = auth_server("pw-a")
+    ctl, cells = make_grid(4, [sa])
+    hot = cells[0]
+    hot_id = hot.id
+    eids = fill_entities(ctl, hot, quadrant_positions())
+    pump(2)  # channel images (with entities) into the WAL
+    tune_partition()
+    for _ in range(30):
+        pump()
+        if partition.ledger.get("split_committed"):
+            break
+    assert ctl.tree.epoch == 1
+    children = ctl.tree.children(hot_id)
+    pump(2)
+    get_global_channel().tick_once(0)  # WAL drain
+    assert wal.flush()
+
+    fresh_runtime()
+    ctl2 = bare_ctl(4)
+    report = boot_replay("", path)
+    assert not report["torn"]
+    assert ctl2.tree.epoch == 1 and ctl2.tree.splits == {hot_id}
+    assert get_channel(hot_id) is None
+    placed = spatial_entity_map()
+    assert sorted(placed) == sorted(eids)
+    assert all(len(v) == 1 and v[0] in children for v in placed.values())
+
+
+def test_kill_mid_split_rehomes_torn_commit(tmp_path):
+    """kill -9 BETWEEN the WAL geometry record and the repartition
+    moves (the torn-commit window): replay lands on the NEW geometry
+    with the parent's image still holding every entity — the re-home
+    pass must move them all into live leaves, zero loss, zero dup."""
+    global_settings.wal_fsync_ms = 1.0
+    path = str(tmp_path / "gw.wal")
+    wal.start(path)
+    sa, _ = auth_server("px-a")
+    ctl, cells = make_grid(4, [sa])
+    hot = cells[0]
+    hot_id = hot.id
+    eids = fill_entities(ctl, hot, quadrant_positions())
+    pump(2)
+    get_global_channel().tick_once(0)  # parent image (12 entities) durable
+    wal.log_geometry(1, frozenset({hot_id}))  # ...then the crash
+    assert wal.flush()
+
+    fresh_runtime()
+    ctl2 = bare_ctl(4)
+    report = boot_replay("", path)
+    assert ctl2.tree.epoch == 1 and ctl2.tree.splits == {hot_id}
+    assert report.get("geometry_rehomed", 0) == len(eids)
+    assert get_channel(hot_id) is None  # non-leaf image swept
+    placed = spatial_entity_map()
+    assert sorted(placed) == sorted(eids)
+    children = set(ctl2.tree.children(hot_id))
+    assert all(len(v) == 1 and v[0] in children for v in placed.values())
+
+
+def test_replay_without_geometry_record_keeps_old_world(tmp_path):
+    """The other side of the commit point: the crash beat the geometry
+    record into the WAL — replay lands on the OLD geometry with nothing
+    moved. Deterministic either way."""
+    global_settings.wal_fsync_ms = 1.0
+    path = str(tmp_path / "gw.wal")
+    wal.start(path)
+    sa, _ = auth_server("py-a")
+    ctl, cells = make_grid(4, [sa])
+    hot = cells[0]
+    eids = fill_entities(ctl, hot, quadrant_positions())
+    hot_id = hot.id
+    pump(2)
+    get_global_channel().tick_once(0)
+    assert wal.flush()
+
+    fresh_runtime()
+    ctl2 = bare_ctl(4)
+    boot_replay("", path)
+    assert ctl2.tree.epoch == 0 and ctl2.tree.splits == frozenset()
+    placed = spatial_entity_map()
+    assert sorted(placed) == sorted(eids)
+    assert all(v == [hot_id] for v in placed.values())
+
+
+# ---- x the concurrent-leader geometry race ---------------------------------
+
+
+def test_concurrent_leader_race_keeps_local_adopts_remote():
+    """Two gateways split concurrently while partitioned: the geometry
+    assertion from the remote leader adopts its splits for REMOTE base
+    cells only — splits under locally-mapped cells stay exactly as the
+    local partition plane committed them."""
+    from channeld_tpu.federation.control import control as global_control
+
+    sa, _ = auth_server("pg-a")
+    sb, _ = auth_server("pg-b")
+    ctl, cells = make_grid(4, [sa, sb])
+    directory.load_dict(
+        {"gateways": {"gw-a": {"servers": [0]}, "gw-b": {"servers": [1]}}},
+        "gw-a",
+    )
+    directory.attach_resolver(ctl.server_index_of_cell)
+    local_cell = cells[0].id    # server 0 -> gw-a (local)
+    remote_cell = cells[3].id   # server 1 -> gw-b (remote)
+    assert directory.is_local_cell(local_cell)
+    assert not directory.is_local_cell(remote_cell)
+
+    ctl.apply_geometry(3, frozenset({local_cell}))
+    # The remote leader's view: it split ITS cell, and its (stale) view
+    # of our side has no splits at all.
+    msg = spatial_pb2.CellGeometryUpdateMessage(
+        geometryEpoch=7, splitCells=[remote_cell], op="sync",
+    )
+    global_control.on_geometry_update("gw-b", msg)
+    assert ctl.tree.epoch == 7
+    assert ctl.tree.splits == {local_cell, remote_cell}
+
+    # A STALE assertion (epoch at or below ours) is rejected outright.
+    stale = spatial_pb2.CellGeometryUpdateMessage(
+        geometryEpoch=7, splitCells=[], op="sync",
+    )
+    global_control.on_geometry_update("gw-b", stale)
+    assert ctl.tree.splits == {local_cell, remote_cell}
+
+
+def test_remote_override_vetoes_split_of_unmappable_children():
+    """Directory overrides are per-cell-id: a split of an overridden
+    cell would scatter its children across gateways (children don't
+    inherit the override) — the governor must veto it."""
+    sa, _ = auth_server("po-a")
+    ctl, cells = make_grid(4, [sa])
+    hot = cells[0]
+    fill_entities(ctl, hot, quadrant_positions())
+    # Every base cell geometrically maps to gw-b; ONLY the hot cell is
+    # overridden back to us. Overrides are per-cell-id, so the hot
+    # cell's children still resolve to gw-b.
+    directory.load_dict(
+        {"gateways": {"gw-a": {"servers": []}, "gw-b": {"servers": [0]}}},
+        "gw-a",
+    )
+    directory.attach_resolver(ctl.server_index_of_cell)
+    directory.apply_update({hot.id: "gw-a"}, version=1)
+    assert directory.is_local_cell(hot.id)
+    assert not directory.is_local_cell(ctl.tree.children(hot.id)[0])
+    tune_partition()
+    pump(3)
+    assert partition.ledger.get("split_vetoed", 0) >= 1
+    assert "split_planned" not in partition.ledger
+    assert ctl.tree.epoch == 0
+
+
+# ---- the seeded smoke soak (tier-1) ---------------------------------------
+
+
+def _load_density_soak():
+    spec = importlib.util.spec_from_file_location(
+        "density_soak", os.path.join(REPO, "scripts", "density_soak.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["density_soak"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_density_smoke_soak():
+    """Seeded <60s live soak: a real gateway under a one-cell density
+    pile-up commits at least one live split, flattens max/mean resident
+    density, loses no entity, and merges back when the crowd leaves."""
+    mod = _load_density_soak()
+    p = mod.DensitySoakParams(
+        warmup_s=3.0, pileup_s=14.0, disperse_s=8.0, quiesce_s=4.0,
+        clients=6, entities=96, msg_rate=15.0,
+        kill_mid_split=False,
+        eval_ticks=8, hold_ticks=2, cooldown_ticks=90,
+    )
+    report = asyncio.run(mod.run_density_soak(p))
+    failed = [c for c in report["invariants"]["checks"] if not c["ok"]]
+    assert report["invariants"]["ok"], failed
+    assert report["partition"]["ledger"].get("split_committed", 0) >= 1
+    assert report["steady_state"]["density_ratio"] <= p.density_ratio_bound

@@ -374,3 +374,105 @@ def test_spatial_server_slot_reclaimed_after_close():
     assert channels[0].id == START
     assert ctl._next_server_index() == 4
     assert channels[0].get_owner() is phoenix
+
+
+# ---- live geometry invariants (adaptive partitioning) ----------------------
+#
+# The pins above encode the REFERENCE's static layout and stay valid
+# because depth-0 cell ids are bit-identical to the legacy formula.
+# Everything below asserts the versioned-geometry invariants instead of
+# layout constants: they must hold for ANY well-formed split set
+# (doc/partitioning.md).
+
+
+def test_depth0_geometry_identical_to_legacy():
+    """Epoch 0 == the static grid: same ids, same regions, same
+    neighborhoods as the pre-tree formulas."""
+    ctl = make_ctl(GridWidth=100, GridHeight=50, GridCols=9, GridRows=8,
+                   ServerCols=3, ServerRows=4)
+    assert ctl.tree is not None and ctl.tree.epoch == 0
+    assert ctl.geometry_epoch == 0
+    regions = ctl.get_regions()
+    assert [r.channelId for r in regions] == list(range(START, START + 72))
+    for gx in range(9):
+        for gz in range(8):
+            info = SpatialInfo(gx * 100 + 50, 0, gz * 50 + 25)
+            assert ctl.get_channel_id(info) == START + gx + gz * 9
+
+
+def test_split_geometry_invariants():
+    """After a split: every in-world position maps to exactly one LIVE
+    LEAF; leaves tile the world exactly (area conservation); regions,
+    adjacency and AOI queries all speak leaf ids; the split cell's id is
+    never returned."""
+    ctl = make_ctl(GridWidth=100, GridHeight=100, GridCols=3, GridRows=3,
+                   ServerCols=1, ServerRows=1)
+    center = START + 4  # grid (1,1)
+    ctl.apply_geometry(1, frozenset({center}))
+    assert ctl.geometry_epoch == 1
+    tree = ctl.tree
+    leaves = tree.leaves()
+    assert center not in leaves and len(leaves) == 12  # 8 base + 4 children
+
+    # Area conservation: the leaf rects tile the world exactly.
+    assert sum(
+        (x1 - x0) * (z1 - z0)
+        for x0, z0, x1, z1 in (tree.rect(c) for c in leaves)
+    ) == pytest.approx(300.0 * 300.0)
+
+    # Position -> unique live leaf; the leaf's rect contains the point.
+    for x in range(5, 300, 10):
+        for z in range(5, 300, 10):
+            cid = ctl.get_channel_id(SpatialInfo(x, 0, z))
+            assert tree.is_leaf(cid)
+            x0, z0, x1, z1 = tree.rect(cid)
+            assert x0 <= x < x1 and z0 <= z < z1
+
+    # Regions: one per live leaf, never the split parent.
+    regions = ctl.get_regions()
+    assert sorted(r.channelId for r in regions) == sorted(leaves)
+    # Children inherit the base cell's server (splits never move
+    # authority by themselves).
+    for r in regions:
+        assert r.serverIndex == 0
+
+    # Adjacency and box AOI return leaf ids only.
+    for c in leaves:
+        for n in ctl.get_adjacent_channels(c):
+            assert tree.is_leaf(n)
+    q = spatial_pb2.SpatialInterestQuery(
+        boxAOI=spatial_pb2.SpatialInterestQuery.BoxAOI(
+            center=spatial_pb2.SpatialInfo(x=150, z=150),
+            extent=spatial_pb2.SpatialInfo(x=60, z=60),
+        )
+    )
+    hit = ctl.query_channel_ids(q)
+    assert hit and center not in hit
+    assert all(tree.is_leaf(c) for c in hit)
+    assert any(tree.depth_of(c) == 1 for c in hit)  # the children show up
+
+
+def test_geometry_versioning_and_validation():
+    """The tree is a VERSIONED directory property: epoch-monotonic
+    apply, whole-set validation (orphan children, depth bound), and
+    deterministic id round-trips at every depth."""
+    ctl = make_ctl(GridWidth=100, GridHeight=100, GridCols=3, GridRows=3,
+                   ServerCols=1, ServerRows=1)
+    tree = ctl.tree
+    child = tree.children(START)[0]
+    # An orphan split (child split without its parent) is rejected whole.
+    with pytest.raises(ValueError):
+        ctl.apply_geometry(1, frozenset({child + 1}))
+    assert ctl.geometry_epoch == 0  # nothing applied
+    # Depth-2 nesting round-trips ids exactly.
+    ctl.apply_geometry(5, frozenset({START, child}))
+    assert ctl.geometry_epoch == 5
+    for leaf in tree.leaves():
+        d, gx, gz = tree.decode(leaf)
+        assert tree.encode(d, gx, gz) == leaf
+        assert tree.base_cell_of(leaf) == tree.base_cell_of(
+            tree.encode(d, gx, gz))
+    # Grandchildren of the twice-split corner are depth 2 and map back
+    # to base cell 0.
+    assert tree.depth_of(tree.children(child)[0]) == 2
+    assert tree.base_cell_of(tree.children(child)[0]) == 0
