@@ -22,7 +22,15 @@ from ..engine import Finding, ModuleInfo, RepoContext, Rule, match_scope
 # exactness is soak-asserted.
 SCOPE: tuple[tuple[str, str], ...] = (
     ("channeld_tpu/spatial/tpu_controller.py",
-     r"^(tick|_apply_follow_interests|_publish_due|_reap_followers)$"),
+     r"^(tick|_apply_follow_interests|_publish_due|_reap_followers|"
+     r"_recenter_followers|collapse_micro_cells)$"),
+    # Standing-query plane (doc/query_engine.md): the consume/apply pass
+    # runs inside the GLOBAL tick and its ledgers are double-entry — a
+    # swallowed failure desynchronizes ledger from metric and the soak's
+    # exactness assertion lies.
+    ("channeld_tpu/spatial/queryplane.py",
+     r"^(pump|_consume|_apply_pending|reap_closed|deregister|_install|"
+     r"_journal|restore_rows)$"),
     ("channeld_tpu/spatial/grid.py", r"^_orchestrate"),
     ("channeld_tpu/spatial/controller.py", r"^tick$"),
     ("channeld_tpu/core/channel.py",

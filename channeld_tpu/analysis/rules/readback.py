@@ -28,7 +28,15 @@ from ..engine import Finding, ModuleInfo, RepoContext, Rule, match_scope
 HOT_PATHS: tuple[tuple[str, str], ...] = (
     ("channeld_tpu/spatial/tpu_controller.py",
      r"^(tick|_apply_follow_interests|_publish_due|_reap_followers|"
-     r"device_due)$"),
+     r"device_due|_recenter_followers|collapse_micro_cells)$"),
+    # The standing-query plane consumes its ONE pre-fetched changed-rows
+    # blob per tick (doc/query_engine.md); every function that runs on
+    # the tick path must stay transfer-free — the designed fetch lives
+    # in engine.query_changed_rows / the guard's _step_body with
+    # reasoned disables.
+    ("channeld_tpu/spatial/queryplane.py",
+     r"^(pump|_consume|_apply_pending|reap_closed|deregister|_install|"
+     r"sensor_cells)$"),
     # The supervised step wraps the per-tick device readbacks; its ONE
     # designed batched fetch (worker-thread _step_body) carries reasoned
     # disables, everything else in the guard must stay transfer-free.

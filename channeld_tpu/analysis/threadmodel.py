@@ -93,6 +93,12 @@ DOMAINS: tuple[Domain, ...] = (
             ("channeld_tpu/core/channel.py", r"^Channel\.tick_once$"),
             ("channeld_tpu/spatial/tpu_controller.py",
              r"^TPUSpatialController\.tick$"),
+            # Standing-query plane (doc/query_engine.md): consume/apply
+            # runs inside the controller tick; seeded explicitly because
+            # the attribute hop (self.queryplane.pump) is not a
+            # module-singleton call the propagator can resolve.
+            ("channeld_tpu/spatial/queryplane.py",
+             r"^QueryPlane\.(pump|reap_closed)$"),
             ("channeld_tpu/spatial/grid.py",
              r"^StaticGrid2DSpatialController\.tick$"),
             ("channeld_tpu/core/connection.py", r"^Connection\.on_bytes$"),

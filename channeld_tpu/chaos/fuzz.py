@@ -352,6 +352,70 @@ def _gen_spatial_probe(rng: Random) -> list:
     return ops
 
 
+def _gen_query_probe(rng: Random) -> list:
+    # Hostile standing-query registrations (spatial/messages.py
+    # _validate_interest_query): NaN/inf centers, negative radii/angles,
+    # spot lists past the queryplane_max_spots cap. The handler must
+    # reject-and-count every one (query_malformed_total) without letting
+    # a non-finite float near the device query table.
+    from ..core.types import MessageType
+    from ..protocol import spatial_pb2
+
+    nan, inf = float("nan"), float("inf")
+
+    def _msg():
+        return spatial_pb2.UpdateSpatialInterestMessage(
+            connId=rng.randrange(1 << 10)
+        )
+
+    def _bad_sphere():
+        m = _msg()
+        m.query.sphereAOI.center.x = rng.choice([nan, inf, -inf, 0.0])
+        m.query.sphereAOI.center.z = rng.choice([nan, 1e308, 0.0])
+        m.query.sphereAOI.radius = rng.choice([nan, inf, -1.0, -1e30, 50.0])
+        return m
+
+    def _bad_box():
+        m = _msg()
+        m.query.boxAOI.center.x = rng.choice([nan, inf, 0.0])
+        m.query.boxAOI.extent.x = rng.choice([nan, -inf, -4.0, 100.0])
+        m.query.boxAOI.extent.z = rng.choice([inf, -1.0, 100.0])
+        return m
+
+    def _bad_cone():
+        m = _msg()
+        m.query.coneAOI.center.z = rng.choice([nan, -inf, 0.0])
+        m.query.coneAOI.direction.x = rng.choice([nan, inf, 1.0])
+        m.query.coneAOI.angle = rng.choice([nan, -0.5, inf, 0.7])
+        m.query.coneAOI.radius = rng.choice([-inf, nan, -2.0, 80.0])
+        return m
+
+    def _oversize_spots():
+        m = _msg()
+        for i in range(rng.randrange(257, 400)):
+            s = m.query.spotsAOI.spots.add()
+            s.x, s.z = float(i), float(i)
+        return m
+
+    def _nan_spots():
+        m = _msg()
+        for _ in range(rng.randrange(1, 8)):
+            s = m.query.spotsAOI.spots.add()
+            s.x = rng.choice([nan, inf, -inf, 1.0])
+            s.z = rng.choice([nan, 3.0])
+        return m
+
+    builders = [_bad_sphere, _bad_box, _bad_cone, _oversize_spots,
+                _nan_spots]
+    ops = []
+    for _ in range(rng.randrange(1, 4)):
+        body = rng.choice(builders)().SerializeToString()
+        ops.append(("data", _frame(MessageType.UPDATE_SPATIAL_INTEREST,
+                                   body, rng.choice([0, 1, 0xFFFF]))))
+        ops.append(("pump",))
+    return ops
+
+
 def _gen_acl_spoof(rng: Random) -> list:
     # Sub/unsub with ANOTHER conn's id (1 = GLOBAL owner, 2 = the honest
     # client in this harness): the ACL must refuse the cross-conn op and
@@ -469,6 +533,7 @@ GENERATORS: dict[str, Callable[[Random], list]] = {
     "hostile_fields": _gen_hostile_fields,
     "splice": _gen_splice,
     "spatial_probe": _gen_spatial_probe,
+    "query_probe": _gen_query_probe,
     "acl_spoof": _gen_acl_spoof,
     "recovery_probe": _gen_recovery_probe,
     "data_update": _gen_data_update,
@@ -488,6 +553,7 @@ _AUTH_ELIGIBLE = {
 }
 _AUTH_ALWAYS = {
     "spatial_probe",
+    "query_probe",
     "acl_spoof",
     "recovery_probe",
     "data_update",

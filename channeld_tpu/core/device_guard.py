@@ -325,6 +325,8 @@ class DeviceGuard:
         result["handovers"] = np.asarray(result["handovers"])  # tpulint: disable=hot-readback -- THE designed once-per-tick batched fetch; downstream reuses these arrays
         result["handover_count"] = int(result["handover_count"])  # tpulint: disable=hot-readback -- rides the same designed per-tick fetch as the rows above
         result["due_packed"] = np.asarray(result["due_packed"])  # tpulint: disable=hot-readback -- rides the same designed per-tick fetch as the rows above
+        if result.get("query_blob") is not None:
+            result["query_blob"] = np.asarray(result["query_blob"])  # tpulint: disable=hot-readback -- the standing-query plane's ONE changed-rows transfer, pre-fetched inside the guarded window (doc/query_engine.md)
         return result
 
     # ---- corruption sentinel ---------------------------------------------
@@ -360,6 +362,24 @@ class DeviceGuard:
         due = result["due_packed"]
         if len(due) != (engine.sub_capacity + 7) // 8:
             return "due bitmap length mismatch"
+        q_blob = result.get("query_blob")
+        if q_blob is not None:
+            q_count = int(q_blob[0])  # tpulint: disable=hot-readback -- q_blob was pre-fetched as host numpy in _step_body; this indexes host memory, not the device
+            q_cap = engine.query_capacity * n_cells
+            if q_count < 0 or q_count > q_cap:
+                return f"query change count {q_count} outside [0, Q*C]"
+            q_rows = q_blob[1:].reshape(-1, 3)
+            head = q_rows[: min(q_count, len(q_rows))]
+            if len(head):
+                live = head[:, 0] >= 0
+                if int(head[:, 0].max(initial=0)) >= engine.query_capacity:
+                    return "query change row beyond query capacity"
+                bad_cell = (head[:, 1] < 0) | (head[:, 1] >= n_cells)
+                if bool((bad_cell & live).any()):
+                    return (
+                        "query change row cites an impossible cell "
+                        f"(grid has {n_cells})"
+                    )
         return None
 
     # ---- failure / recovery ----------------------------------------------

@@ -529,6 +529,55 @@ follower_interest_ms = Histogram(
     registry=registry,
 )
 
+# Standing-query plane (spatial/queryplane.py; doc/query_engine.md).
+# Every counter below has a python-side double-entry ledger on the
+# plane (QueryPlane.ledgers) that must match exactly — the soak/bench
+# invariant gates compare the two.
+standing_queries = Gauge(
+    "standing_queries",
+    "Live standing-query registrations on the device query plane "
+    "(scope: follow = entity-follow AOI, client = UpdateSpatialInterest "
+    "query rows, sensor = server-facing sensor API)",
+    ["scope"],
+    registry=registry,
+)
+query_rows_changed = Counter(
+    "query_rows_changed_total",
+    "Changed (query, cell, dist) rows consumed from the per-tick "
+    "device diff — the plane's entire host workload is O(this), "
+    "not O(standing queries)",
+    registry=registry,
+)
+query_pass_ms = Histogram(
+    "query_pass_ms",
+    "Host cost of one standing-query plane pass (consume the changed "
+    "rows + apply pending sub/unsub diffs), milliseconds",
+    buckets=(0.05, 0.1, 0.5, 1.0, 2.0, 5.0, 10.0, 33.0, 100.0),
+    registry=registry,
+)
+query_plane_transfers = Counter(
+    "query_plane_transfers_total",
+    "Changed-rows blobs consumed — by design exactly ONE device->host "
+    "transfer per tick however many standing queries exist (the bench "
+    "gate divides this by ticks and demands 1.0)",
+    registry=registry,
+)
+query_full_resyncs = Counter(
+    "query_full_resyncs_total",
+    "Query-plane mirror full resyncs: the engine's query epoch moved "
+    "(device-guard rebuild or geometry epoch threw the diff baseline "
+    "away), so every registered query re-applies from scratch",
+    registry=registry,
+)
+query_malformed = Counter(
+    "query_malformed_total",
+    "UpdateSpatialInterest messages rejected before touching any "
+    "query table (field: which validation tripped — hostile NaN/inf "
+    "centers, negative radius/angle, oversize spot lists)",
+    ["field"],
+    registry=registry,
+)
+
 # Fleet health plane: end-to-end delivery SLOs (core/slo.py;
 # doc/observability.md). The bucket edges are shared with the SLO
 # plane's python-side tally (slo.delivery_quantile — the soak's <5ms

@@ -314,6 +314,20 @@ class GlobalSettings:
     partition_freeze_min_ticks: int = 2
     partition_drain_deadline_ticks: int = 120
 
+    # Standing-query plane (new — doc/query_engine.md). With the TPU
+    # backend every standing interest (entity followers, client AOI
+    # queries, server sensors) becomes a device query row: one batched
+    # mask pass + on-device diff per tick, one changed-rows transfer,
+    # O(changed) host apply. ON by default with spatial_backend=tpu;
+    # host backend ignores it (host interest stays per-query).
+    queryplane_enabled: bool = True
+    # Changed-rows budget per tick (the fixed compaction width; changes
+    # beyond it stay in the device baseline and re-emit next tick).
+    queryplane_rows_max: int = 8192
+    # Upper bound on a client spots query's spot list — beyond this the
+    # UpdateSpatialInterest message is rejected as malformed.
+    queryplane_max_spots: int = 256
+
     # Cross-gateway federation plane (new — doc/federation.md). Empty
     # config path = the plane stays disarmed and every hook is a cheap
     # no-op (the gateway is a self-contained world, the pre-federation
@@ -613,6 +627,23 @@ class GlobalSettings:
                        help="committed geometry ops allowed per epoch "
                             "(epoch = partition_epoch_ticks GLOBAL "
                             "ticks)")
+        p.add_argument("-queryplane",
+                       type=lambda s: s.lower() not in
+                       ("false", "0", "no", "off"),
+                       default=self.queryplane_enabled,
+                       help="device standing-query plane: followers, "
+                            "client AOI queries and server sensors "
+                            "evaluated in one batched device pass per "
+                            "tick (doc/query_engine.md); false keeps "
+                            "the per-follower host readback path")
+        p.add_argument("-queryplane-rows", type=int,
+                       default=self.queryplane_rows_max,
+                       help="changed (query, cell, dist) rows budget per "
+                            "tick; overflow re-emits next tick")
+        p.add_argument("-queryplane-max-spots", type=int,
+                       default=self.queryplane_max_spots,
+                       help="max spots per client spots query; larger "
+                            "lists are rejected as malformed")
         p.add_argument("-fed", type=str, default="",
                        help="federation config JSON path (shard directory "
                             "+ trunk addresses, doc/federation.md); empty "
@@ -777,6 +808,9 @@ class GlobalSettings:
         )
         self.partition_max_depth = args.partition_depth
         self.partition_budget_per_epoch = args.partition_budget
+        self.queryplane_enabled = args.queryplane
+        self.queryplane_rows_max = args.queryplane_rows
+        self.queryplane_max_spots = args.queryplane_max_spots
         self.federation_config = args.fed
         self.federation_gateway_id = args.fed_id
         self.global_control_enabled = args.global_control

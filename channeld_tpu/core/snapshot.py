@@ -94,6 +94,17 @@ def _pack_extras(snap: snapshot_pb2.GatewaySnapshot) -> None:
     if _ctl is not None and getattr(_ctl, "tree", None) is not None:
         snap.geometryEpoch = _ctl.tree.epoch
         snap.splitCells.extend(sorted(_ctl.tree.splits))
+    # Standing-query registry (spatial/queryplane.py): checkpoint
+    # truncation drops the WAL's query records, so the snapshot must
+    # carry the registry or a post-checkpoint restart would silently
+    # lose every sensor.
+    _plane = getattr(_ctl, "queryplane", None) if _ctl is not None else None
+    if _plane is not None:
+        for key, scope, name, kind, params, spot_dists in _plane.snapshot_rows():
+            snap.standingQueries.add(
+                key=key, scope=scope, name=name, kind=kind,
+                params=params, spotDists=spot_dists,
+            )
     # In-flight handover transactions (an entity mid-crossing is in
     # NEITHER cell's data — same blindness the epoch replica closes).
     # Remote records carry their trunk batch identity for the
@@ -229,6 +240,11 @@ def extras_from(snap: snapshot_pb2.GatewaySnapshot) -> dict:
             for a in snap.applied
         },
         "geometry": (snap.geometryEpoch, frozenset(snap.splitCells)),
+        "queries": {
+            q.key: (q.key, q.scope, q.name, q.kind,
+                    list(q.params), list(q.spotDists))
+            for q in snap.standingQueries
+        },
     }
 
 
@@ -274,6 +290,11 @@ def restore_snapshot(path: str) -> int:
             plane._applied.setdefault(key, row)
         while len(plane._applied) > MAX_APPLIED_BATCHES:
             plane._applied.popitem(last=False)
+    if extras["queries"]:
+        from ..spatial.queryplane import restore_registrations
+
+        restore_registrations(sorted(extras["queries"].values()),
+                              source="snapshot restore")
     return restored
 
 

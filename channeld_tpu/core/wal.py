@@ -369,6 +369,17 @@ class WriteAheadLog:
             blacklistKind=kind, blacklistKey=key,
         ))
 
+    def log_query(self, op: str, key: int, scope: str, name: str,
+                  kind: int, params, spot_dists) -> None:
+        """One standing-query registration transition (op = set |
+        remove) on the device query plane (spatial/queryplane.py);
+        last record per key wins at replay."""
+        self.append("query", wal_pb2.WalRecord(
+            op=op, queryKey=key, queryScope=scope, queryName=name,
+            queryKind=kind, queryParams=list(params),
+            querySpotDists=list(spot_dists),
+        ))
+
     # ---- durability barrier / checkpoint ---------------------------------
 
     def flush(self, timeout_s: float = 5.0) -> bool:
@@ -592,6 +603,8 @@ def boot_replay(snapshot_path: str, wal_path: str) -> dict:
     geometry_state = (
         extras["geometry"] if extras is not None else (0, frozenset())
     )
+    # key -> (key, scope, name, kind, params, spot_dists); last wins.
+    queries: dict[int, tuple] = dict(extras["queries"]) if extras else {}
     flips: dict[int, int] = {}
     for r in records:
         k = r.kind
@@ -643,6 +656,14 @@ def boot_replay(snapshot_path: str, wal_path: str) -> dict:
                 banned_pits.add(r.blacklistKey)
         elif k == "geometry":
             geometry_state = (r.geometryEpoch, frozenset(r.splitCells))
+        elif k == "query":
+            if r.op == "remove":
+                queries.pop(r.queryKey, None)
+            else:
+                queries[r.queryKey] = (
+                    r.queryKey, r.queryScope, r.queryName, r.queryKind,
+                    list(r.queryParams), list(r.querySpotDists),
+                )
         else:
             logger.warning("unknown WAL record kind %r skipped", k)
 
@@ -728,6 +749,20 @@ def boot_replay(snapshot_path: str, wal_path: str) -> dict:
             wal._count_replayed("staged_handle")
         except RuntimeError as e:
             logger.warning("boot replay: re-staging %s failed: %s", pit, e)
+    if queries:
+        # Standing-query registry (spatial/queryplane.py): sensor rows
+        # re-register on the live plane; connection-scoped rows are
+        # bound to sockets that did not survive the restart and drop
+        # with an exact count.
+        from ..spatial.queryplane import restore_registrations
+
+        n_restored, n_dropped = restore_registrations(
+            sorted(queries.values()), source="wal replay",
+        )
+        if n_restored:
+            wal._count_replayed("query", n_restored)
+        if n_dropped:
+            wal._count_replayed("query_dropped", n_dropped)
     from ..federation.directory import directory
 
     version, overrides = directory_state

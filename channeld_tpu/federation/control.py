@@ -817,6 +817,22 @@ class GlobalControlPlane:
                 self.plane._applied.items():
             msg.applied.add(batchId=batch_id, peer=src_peer,
                             entityIds=eids)
+        # Sensor-scope standing queries ride the replica next to the
+        # staged handles: an adopter re-registers them on its own query
+        # plane (spatial/queryplane.py) so a server sensor survives its
+        # gateway's death. Connection-scoped rows stay home — their
+        # sockets die with the gateway and clients re-issue on resume.
+        from ..spatial.controller import get_spatial_controller
+
+        _ctl = get_spatial_controller()
+        _qp = getattr(_ctl, "queryplane", None) if _ctl is not None else None
+        if _qp is not None:
+            for key, scope, name, kind, params, spot_dists in \
+                    _qp.snapshot_rows():
+                if scope != "sensor":
+                    continue
+                msg.queries.add(key=key, scope=scope, name=name, kind=kind,
+                                params=params, spotDists=spot_dists)
         for peer in peers:
             link = self.plane.link_to(peer)
             if link is not None:
@@ -2341,6 +2357,23 @@ class GlobalControlPlane:
                     )
                     continue
                 staged += 1
+            # 5. The dead gateway's sensor-scope standing queries
+            #    (spatial/queryplane.py): re-registered on THIS
+            #    gateway's query plane so server sensors survive their
+            #    host's death the way staged handles do. Keys collide
+            #    by design — a sensor already registered here (e.g. a
+            #    second adoption of the same replica) re-installs onto
+            #    its existing engine row, not a duplicate.
+            if replica.queries:
+                from ..spatial.queryplane import restore_registrations
+
+                q_restored, _q_dropped = restore_registrations(
+                    [(q.key, q.scope, q.name, q.kind, list(q.params),
+                      list(q.spotDists)) for q in replica.queries],
+                    source="adoption",
+                )
+                if q_restored:
+                    self._note("queries_adopted", q_restored)
         # The adopter's own resurrection candidates (committed INTO the
         # dead gateway, never replicated back) restore here too, census
         # vetoed like everything else.

@@ -29,6 +29,8 @@ from .spatial_ops import (
     AOI_SPOTS,
     GridSpec,
     QuerySet,
+    diff_query_masks,
+    parse_query_blob,
     spatial_step,
 )
 
@@ -46,6 +48,7 @@ class SpatialEngine:
         mesh=None,
         sharding: str = "entities",
         cell_bucket: int = 0,
+        query_rows_max: int = 8192,
     ):
         """``mesh``: a jax.sharding.Mesh to shard the entity slot arrays
         over (from parallel.mesh.make_mesh / make_mesh_2d). None = the
@@ -124,6 +127,26 @@ class SpatialEngine:
         self._d_spot_dist = None  # tpulint: shared=fence
         self._spot_dirty_rows: set[int] = set()  # tpulint: shared=fence
         self._queries_dirty = True  # tpulint: shared=fence
+
+        # Standing-query plane (doc/query_engine.md): when enabled the
+        # tick diffs this tick's interest/dist masks against the
+        # committed device baseline and compacts the delta to changed
+        # (query, cell, dist) rows — the plane's ONE d2h transfer.
+        self.track_query_changes = False
+        self.query_rows_max = query_rows_max
+        # Committed (interest, dist) baseline pair; None = empty baseline
+        # (next diff full-emits every interested row).
+        self._d_q_prev = None  # tpulint: shared=fence
+        # Rows whose baseline must be zeroed before the next diff: a
+        # freshly-allocated (or freed) row may be REUSED by a new query,
+        # and a stale baseline would swallow the overlap between the old
+        # and new masks (never re-emitted = lost subscription).
+        self._q_prev_reset_rows: set[int] = set()  # tpulint: shared=fence
+        # Bumped whenever the committed baseline is thrown away wholesale
+        # (rebuild_device_state / apply_grid): the host plane sees the
+        # epoch move and full-resyncs its mirrors instead of trusting
+        # deltas that no longer connect to its last-applied state.
+        self.query_epoch = 0  # tpulint: shared=fence
 
         # Host staging for the sub table. The device's last-fan-out column
         # is authoritative after each tick (fanout_due advances it); the
@@ -240,6 +263,10 @@ class SpatialEngine:
                 raise RuntimeError("query capacity exhausted")
             q = self._q_free.pop()
             self._q_of_conn[conn_id] = q
+            # Fresh owner for this row: zero its diff baseline before the
+            # next tick so the previous occupant's mask can't swallow the
+            # overlap with the new query (see _q_prev_reset_rows).
+            self._q_prev_reset_rows.add(q)
         return q
 
     def set_query(
@@ -315,6 +342,10 @@ class SpatialEngine:
                 self._q_spot_dist[q] = -1
                 self._spot_dirty_rows.add(q)
             self._q_free.append(q)
+            # A freed row emits no removal rows (the plane unsubscribes
+            # synchronously at deregistration) and must hand its next
+            # owner a clean diff baseline.
+            self._q_prev_reset_rows.add(q)
             self._queries_dirty = True
 
     def query_row_of_conn(self, conn_id: int) -> Optional[int]:
@@ -499,6 +530,33 @@ class SpatialEngine:
                 jnp.int32(now_ms),
                 use_pallas=self.use_pallas,
             )
+        q_prev = None
+        if self.track_query_changes:
+            prev = self._d_q_prev
+            if prev is None:
+                prev = (
+                    jnp.zeros(out["interest"].shape, bool),
+                    jnp.zeros(out["interest"].shape, jnp.int32),
+                )
+            elif self._q_prev_reset_rows:
+                # Reused rows start from an empty baseline (pure compute
+                # on the old arrays; committed only after the gen check).
+                idx = np.fromiter(
+                    self._q_prev_reset_rows, np.int32,
+                    len(self._q_prev_reset_rows),
+                )
+                prev = (prev[0].at[idx].set(False), prev[1].at[idx].set(0))
+            q_blob, q_prev_i, q_prev_d = diff_query_masks(
+                prev[0], prev[1], out["interest"], out["dist"],
+                self.query_rows_max,
+            )
+            out["query_blob"] = q_blob
+            out["query_epoch"] = self.query_epoch
+            q_prev = (q_prev_i, q_prev_d)
+        else:
+            # No baseline while tracking is off — when it turns on, the
+            # None baseline full-emits anyway, so pending resets are moot.
+            self._q_prev_reset_rows.clear()
         if gen != self.generation:
             # The watchdog abandoned this step (device_guard): the
             # engine may already be rebuilt — committing this tick's
@@ -512,6 +570,9 @@ class SpatialEngine:
             self._d_sub_state[1],
             self._d_sub_state[2],
         )
+        if q_prev is not None:
+            self._d_q_prev = q_prev
+            self._q_prev_reset_rows.clear()
         self.last_result = out
         return out
 
@@ -633,6 +694,25 @@ class SpatialEngine:
             out[cid] = {int(c): int(drow[c]) for c in cells}
         return out
 
+    def query_changed_rows(self, result: dict) -> tuple[int, np.ndarray]:
+        """(total_changed, rows i32[query_rows_max, 3]) from a tick
+        result — the standing-query plane's ONE device->host transfer
+        per tick (doc/query_engine.md). The fetched blob is cached back
+        onto the result dict, so however many consumers ask, the
+        transfer happens at most once per tick (the device guard
+        pre-fetches it inside the guarded step window; this path is the
+        unguarded fallback). Row layout: (query_row, cell, new_dist)
+        with dist == -1 meaning interest removed; rows beyond
+        min(total, query_rows_max) are -1 padding. Returns (0, empty)
+        when tracking was off for this tick."""
+        blob = result.get("query_blob")
+        if blob is None:
+            return 0, np.zeros((0, 3), np.int32)
+        if not isinstance(blob, np.ndarray):
+            blob = np.asarray(blob)  # tpulint: disable=hot-readback -- the plane's designed once-per-tick changed-rows fetch (unguarded path; cached on the result)
+            result["query_blob"] = blob
+        return parse_query_blob(blob)
+
     # ---- supervision & recovery (core/device_guard.py) -------------------
 
     def tracked_entities(self) -> list[tuple[int, int]]:
@@ -704,6 +784,13 @@ class SpatialEngine:
         self._d_spot_dist = None
         self._spot_dirty_rows.clear()
         self._queries_dirty = True
+        # Standing-query diff baseline: gone with the rest of the device
+        # state. The epoch bump tells the host plane its mirrors no
+        # longer connect to the next tick's delta stream — it must
+        # full-resync (every query re-emits against the empty baseline).
+        self._d_q_prev = None
+        self._q_prev_reset_rows.clear()
+        self.query_epoch += 1
         # Sub table: intervals/active from the host mirror; the
         # device-authoritative last-fan-out column restarts at now.
         self._sub_last[self._sub_active] = now_ms

@@ -354,3 +354,71 @@ def parse_consume_blob(blob, max_handovers: int, num_cells: int, num_subs: int):
     due_words = blob[rows_end + num_cells :]
     due = np.unpackbits(due_words.view(np.uint8))[:num_subs]
     return count, rows, counts, due
+
+
+# ---- standing-query diff / compaction (doc/query_engine.md) ---------------
+
+
+@partial(jax.jit, static_argnums=(4,))
+def diff_query_masks(
+    prev_interest: jnp.ndarray,  # bool[Q,C] committed baseline
+    prev_dist: jnp.ndarray,  # i32[Q,C]
+    interest: jnp.ndarray,  # bool[Q,C] this tick's masks
+    dist: jnp.ndarray,  # i32[Q,C]
+    max_rows: int,
+):
+    """Diff this tick's query-interest masks against the committed
+    baseline ON DEVICE and compact the delta to ``(query, cell, dist)``
+    rows — the standing-query plane's entire per-tick host protocol.
+
+    A (q, c) entry is *changed* when interest flipped either way, or when
+    it stayed interested but the damping distance moved (the host must
+    re-subscribe with refreshed fan-out options, mirroring
+    apply_interest_diff's always-refresh semantics). Rows carry the NEW
+    dist; ``dist == -1`` means interest was removed. Compaction reuses the
+    cumsum-rank scatter of compact_handovers over the flattened [Q*C]
+    plane. Changes beyond ``max_rows`` keep their *previous* baseline
+    value so they re-diff next tick instead of being lost (same overflow
+    contract as handovers); ``count`` reports the true total so the host
+    can see the backlog.
+
+    Returns (blob i32[1+3*max_rows], next_interest bool[Q,C],
+    next_dist i32[Q,C]) where blob = [count][rows row-major] is the ONE
+    device->host transfer the plane is allowed per tick, and next_* is
+    the baseline to commit for the following tick.
+    """
+    q, c = interest.shape
+    max_rows = min(max_rows, q * c)
+    changed = (interest != prev_interest) | (interest & (dist != prev_dist))
+    flat = changed.reshape(-1)
+    n = flat.shape[0]
+    count = jnp.sum(flat, dtype=jnp.int32)
+    rank = jnp.cumsum(flat, dtype=jnp.int32) - 1
+    reported = flat & (rank < max_rows)
+    slot = jnp.where(reported, rank, max_rows)
+    idx = (
+        jnp.zeros(max_rows + 1, jnp.int32)
+        .at[slot]
+        .set(jnp.arange(n, dtype=jnp.int32), mode="drop")[:max_rows]
+    )
+    new_dist = jnp.where(interest.reshape(-1)[idx], dist.reshape(-1)[idx], -1)
+    rows = jnp.stack([idx // c, idx % c, new_dist], axis=1)
+    row_valid = jnp.arange(max_rows) < jnp.minimum(count, max_rows)
+    rows = jnp.where(row_valid[:, None], rows, -1)
+    keep_prev = (changed & ~reported.reshape(q, c))
+    next_interest = jnp.where(keep_prev, prev_interest, interest)
+    next_dist = jnp.where(keep_prev, prev_dist, dist)
+    blob = jnp.concatenate([count[None], rows.reshape(-1)])
+    return blob, next_interest, next_dist
+
+
+def parse_query_blob(blob):
+    """Host-side split of the standing-query changed-rows blob (numpy):
+    (total_changed, rows i32[R,3]) where R is the blob's own row budget
+    (diff_query_masks clamps the configured max to Q*C, so the effective
+    budget is read from the blob, never assumed); rows beyond
+    min(total, R) are -1 padding."""
+    import numpy as np
+
+    blob = np.asarray(blob)
+    return int(blob[0]), blob[1:].reshape(-1, 3)

@@ -149,6 +149,16 @@ def handle_update_spatial_interest(ctx) -> None:
         else:
             _fed_plane.clear_client_anchor(client_conn.id)
 
+    bad_field = _validate_interest_query(msg.query)
+    if bad_field is not None:
+        # Hostile or broken query fields (NaN/inf centers, negative
+        # radius/angle, oversize spot lists) are rejected BEFORE touching
+        # any query table — host or device. Counted (the operator-visible
+        # malformed finding) + throttled security log; the connection's
+        # existing interest is left untouched.
+        _count_malformed(bad_field, msg.connId)
+        return
+
     register = getattr(controller, "register_follow_interest", None)
     unregister = getattr(controller, "unregister_follow_interest", None)
     if callable(register):
@@ -171,6 +181,117 @@ def handle_update_spatial_interest(ctx) -> None:
         origin_channel=ctx.channel, origin_channel_id=ctx.channel_id,
         stub_id=ctx.stub_id,
     )
+
+    # Standing-query plane (doc/query_engine.md): the synchronous host
+    # apply above keeps the handler's semantics byte-identical; the
+    # device row registered here keeps the interest LIVE — geometry
+    # epochs, device rebuilds, and damping-distance drift re-apply it
+    # with no further client messages.
+    plane = getattr(controller, "queryplane", None)
+    if plane is not None:
+        _register_standing_query(plane, client_conn, msg.query)
+
+
+_malformed_logged: dict[str, float] = {}  # field -> last log time
+
+
+def _count_malformed(field: str, conn_id: int) -> None:
+    """Operator-visible malformed-query finding: metric always, security
+    log throttled per field (a hostile client repeats forever)."""
+    import time as _time
+
+    from ..core import metrics
+    from ..utils.logger import security_logger
+
+    metrics.query_malformed.labels(field=field).inc()
+    now = _time.monotonic()
+    if now - _malformed_logged.get(field, -1e9) >= 5.0:
+        _malformed_logged[field] = now
+        security_logger().warning(
+            "malformed UpdateSpatialInterest rejected (%s) from conn %d "
+            "(query_malformed_total counts every occurrence)",
+            field, conn_id,
+        )
+
+
+def _validate_interest_query(
+    query: spatial_pb2.SpatialInterestQuery,
+) -> Optional[str]:
+    """Reject hostile query fields before they touch any query table:
+    the name of the offending field, or None when clean. NaN/inf
+    coordinates would poison the device mask math (NaN comparisons are
+    all-false — a silently empty interest) or wedge the host sampling
+    loops; negative radius/angle invert shape tests; an unbounded spots
+    list is an O(N) rasterization the sender controls."""
+    import math
+
+    def finite(*vals) -> bool:
+        return all(math.isfinite(float(v)) for v in vals)
+
+    if query.HasField("spotsAOI"):
+        spots = query.spotsAOI.spots
+        if len(spots) > global_settings.queryplane_max_spots:
+            return "spots_oversize"
+        if not all(finite(s.x, s.y, s.z) for s in spots):
+            return "spots_not_finite"
+    if query.HasField("boxAOI"):
+        box = query.boxAOI
+        if not finite(box.center.x, box.center.z, box.extent.x,
+                      box.extent.z):
+            return "box_not_finite"
+        if box.extent.x < 0 or box.extent.z < 0:
+            return "box_extent_negative"
+    if query.HasField("sphereAOI"):
+        sph = query.sphereAOI
+        if not finite(sph.center.x, sph.center.z, sph.radius):
+            return "sphere_not_finite"
+        if sph.radius < 0:
+            return "sphere_radius_negative"
+    if query.HasField("coneAOI"):
+        cone = query.coneAOI
+        if not finite(cone.center.x, cone.center.z, cone.direction.x,
+                      cone.direction.z, cone.angle, cone.radius):
+            return "cone_not_finite"
+        if cone.radius < 0:
+            return "cone_radius_negative"
+        if cone.angle < 0:
+            return "cone_angle_negative"
+    return None
+
+
+def _register_standing_query(plane, conn, query) -> None:
+    """Map a validated client query onto one standing device row
+    (spatial/queryplane.py). An empty query (no AOI field) clears the
+    standing registration — the host apply above already unsubscribed."""
+    from ..ops.spatial_ops import AOI_BOX, AOI_CONE, AOI_SPHERE
+
+    if query.HasField("spotsAOI"):
+        plane.register_client_spots(
+            conn,
+            [(s.x, s.z) for s in query.spotsAOI.spots],
+            list(query.spotsAOI.dists) or None,
+        )
+    elif query.HasField("sphereAOI"):
+        sph = query.sphereAOI
+        plane.register_client(
+            conn, AOI_SPHERE, (sph.center.x, sph.center.z),
+            (sph.radius, 0.0),
+        )
+    elif query.HasField("boxAOI"):
+        box = query.boxAOI
+        plane.register_client(
+            conn, AOI_BOX, (box.center.x, box.center.z),
+            (box.extent.x, box.extent.z),
+        )
+    elif query.HasField("coneAOI"):
+        cone = query.coneAOI
+        plane.register_client(
+            conn, AOI_CONE, (cone.center.x, cone.center.z),
+            (cone.radius, 0.0), (cone.direction.x, cone.direction.z),
+            cone.angle,
+        )
+    else:
+        plane.deregister(conn.id)
 
 
 def _query_to_engine_params(query: spatial_pb2.SpatialInterestQuery):

@@ -91,6 +91,11 @@ class TPUSpatialController(StaticGrid2DSpatialController):
         self._mw = 0.0
         self._mh = 0.0
         self._micro_leaf: Optional[list[int]] = None
+        # Standing-query plane (spatial/queryplane.py;
+        # doc/query_engine.md): None = disabled, the legacy per-follower
+        # batch-readback path serves follows and client queries stay
+        # host-evaluated per message.
+        self.queryplane = None
 
     def load_config(self, config: dict) -> None:
         super().load_config(config)
@@ -134,7 +139,14 @@ class TPUSpatialController(StaticGrid2DSpatialController):
             mesh=mesh,
             sharding=str(config.get("Sharding", "entities")),
             cell_bucket=int(config.get("CellBucket", 0)),
+            query_rows_max=global_settings.queryplane_rows_max,
         )
+        if global_settings.queryplane_enabled:
+            from .queryplane import QueryPlane
+
+            # Created BEFORE warmup so the warmup tick also compiles the
+            # on-device diff/compaction step.
+            self.queryplane = QueryPlane(self, self.engine)
         self.engine.warmup()  # compile before listeners open (see warmup)
 
     # ---- decision plane --------------------------------------------------
@@ -532,10 +544,21 @@ class TPUSpatialController(StaticGrid2DSpatialController):
             "extent": extent, "direction": direction, "angle": angle,
             "center": center,
         }
+        if self.queryplane is not None:
+            self.queryplane.bind_follow(conn, follow_entity_id, kind,
+                                        center, extent, direction, angle)
 
     def unregister_follow_interest(self, conn_id: int) -> None:
         if self._followers.pop(conn_id, None) is not None:
-            self.engine.remove_query(conn_id)
+            if self.queryplane is not None:
+                # Frees the engine row AND zeroes its diff baseline —
+                # no dead row stays in the batched pass, and a reused
+                # row can't leak the old mask (bounded-registry
+                # discipline; the row-reuse hazard is pinned by
+                # tests/test_queryplane.py churn coverage).
+                self.queryplane.deregister(conn_id)
+            else:
+                self.engine.remove_query(conn_id)
 
     def _reap_followers(self) -> None:
         from ..spatial.messages import apply_interest_diff
@@ -557,23 +580,34 @@ class TPUSpatialController(StaticGrid2DSpatialController):
                 # "seen" is only set once the entity appears).
                 self.unregister_follow_interest(conn_id)
                 apply_interest_diff(entry["conn"], {})
+        if self.queryplane is not None:
+            # Client-scope standing rows ride connections too: reap the
+            # closed ones so the device pass stays bounded by LIVE
+            # registrations under churn.
+            self.queryplane.reap_closed()
 
-    def _apply_follow_interests(self, result) -> None:
-        import time as _time
-
-        from ..core import metrics
-        from ..spatial.messages import apply_interest_diff
-
+    def collapse_micro_cells(self, desired: dict[int, int]) -> dict[int, int]:
+        """{micro_cell: dist} -> {leaf_channel_id: dist}. Micro cells
+        collapse onto leaf CHANNELS; several micro cells of one leaf ->
+        keep the closest distance (interest priority is distance-ranked).
+        Identity (+ id offset) while no split is live."""
         start = global_settings.spatial_channel_id_start
-        live: list[int] = []
+        if self._micro_leaf is None:
+            return {start + cell: dist for cell, dist in desired.items()}
+        wanted: dict[int, int] = {}
+        for cell, dist in desired.items():
+            ch = self._leaf_of_cell(cell)
+            if ch not in wanted or dist < wanted[ch]:
+                wanted[ch] = dist
+        return wanted
+
+    def _recenter_followers(self) -> None:
+        """Re-center each follow query on its entity for the *next*
+        tick; skips the query-table write when the entity hasn't moved
+        (the table upload is O(capacity))."""
         for conn_id, entry in list(self._followers.items()):
-            conn = entry["conn"]
-            if conn.is_closing():
-                self.unregister_follow_interest(conn_id)
-                continue
-            # Re-center on the followed entity for the *next* tick; skip the
-            # query-table write when the entity hasn't moved (the table
-            # upload is O(capacity)).
+            if entry["conn"].is_closing():
+                continue  # _reap_followers owns removal
             info = self._last_positions.get(entry["entity"])
             if info is not None and (info.x, info.z) != entry["center"]:
                 self.engine.set_query(
@@ -581,7 +615,31 @@ class TPUSpatialController(StaticGrid2DSpatialController):
                     entry["extent"], entry["direction"], entry["angle"],
                 )
                 entry["center"] = (info.x, info.z)
+
+    def register_sensor(self, name: str, **kwargs):
+        """Server-facing standing sensor (spatial/queryplane.py): a named
+        AOI query with no connection, evaluated in the same batched
+        device pass as every follower and client query. Returns the
+        sensor key, or None when the plane is disabled or the query
+        table is full."""
+        if self.queryplane is None:
+            return None
+        return self.queryplane.register_sensor(name, **kwargs)
+
+    def _apply_follow_interests(self, result) -> None:
+        import time as _time
+
+        from ..core import metrics
+        from ..spatial.messages import apply_interest_diff
+
+        live: list[int] = []
+        for conn_id, entry in list(self._followers.items()):
+            conn = entry["conn"]
+            if conn.is_closing():
+                self.unregister_follow_interest(conn_id)
+                continue
             live.append(conn_id)
+        self._recenter_followers()
         if not live:
             return
         # ONE device->host transfer of the whole interest/dist tables for
@@ -599,18 +657,7 @@ class TPUSpatialController(StaticGrid2DSpatialController):
             entry = self._followers.get(conn_id)
             if entry is None:
                 continue
-            desired = desired_all.get(conn_id, {})
-            if self._micro_leaf is None:
-                wanted = {start + cell: dist for cell, dist in desired.items()}
-            else:
-                # Micro cells collapse onto leaf CHANNELS; several micro
-                # cells of one leaf -> keep the closest distance (interest
-                # priority is distance-ranked).
-                wanted = {}
-                for cell, dist in desired.items():
-                    ch = self._leaf_of_cell(cell)
-                    if ch not in wanted or dist < wanted[ch]:
-                        wanted[ch] = dist
+            wanted = self.collapse_micro_cells(desired_all.get(conn_id, {}))
             apply_interest_diff(entry["conn"], wanted)
 
     def tick(self) -> None:
@@ -620,8 +667,12 @@ class TPUSpatialController(StaticGrid2DSpatialController):
         self._reap_followers()  # even with no entities tracked
         # A tick is needed when entities move OR device-registered fan-out
         # subscriptions exist (due decisions come from the engine even for
-        # an entity-less spatial world, e.g. pure chat-over-spatial).
-        if self.engine.entity_count() == 0 and self._device_sub_count == 0:
+        # an entity-less spatial world, e.g. pure chat-over-spatial) OR
+        # standing queries are registered (a sensor over a static world
+        # still needs its first evaluation + epoch re-applies).
+        if (self.engine.entity_count() == 0 and self._device_sub_count == 0
+                and (self.queryplane is None
+                     or self.queryplane.count() == 0)):
             return
         from ..core import metrics
 
@@ -751,7 +802,36 @@ class TPUSpatialController(StaticGrid2DSpatialController):
             StaticGrid2DSpatialController.notify_crossings(self, batch)
             _governor.note_handover_cost(_time.monotonic() - t_ho)
             _trace.stage("handover", int(t_ho * 1e9))
-        if self._followers:
+        if self.queryplane is not None:
+            # Standing-query plane (doc/query_engine.md): ONE changed-
+            # rows consume per tick, apply O(changed). The CONSUME always
+            # drains — the device already committed this tick's baseline,
+            # so an unconsumed blob is a permanently lost delta; at L2+
+            # only the APPLY pass (and follower re-centering) alternates
+            # ticks, halving standing-query cadence exactly as the
+            # legacy follower path halves.
+            defer = _governor.level >= 2 and not self._follow_skip
+            t_fi = _time.monotonic()
+            if defer:
+                self._follow_skip = True
+                # An empty registry sheds nothing — a zero count would
+                # still create the ledger key and break the soaks'
+                # exact shed accounting.
+                if self.queryplane.count():
+                    _governor.count_shed(
+                        "query_apply_defer", self.queryplane.count()
+                    )
+            else:
+                self._follow_skip = False
+                self._recenter_followers()
+            self.queryplane.pump(result, apply=not defer)
+            cost = _time.monotonic() - t_fi
+            _trace.stage("query_plane", int(t_fi * 1e9))
+            # Same pressure-signal input the legacy follower pass fed:
+            # the plane's host cost is the follower cost now.
+            metrics.follower_interest_ms.observe(cost * 1000.0)
+            _governor.note_follower_cost(cost)
+        elif self._followers:
             if _governor.level >= 2 and not self._follow_skip:
                 # L2+: follower interests re-center every OTHER tick —
                 # half the host cost, interest diffs lag one tick.

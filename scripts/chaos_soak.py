@@ -520,6 +520,10 @@ async def run_soak(p: SoakParams) -> dict:
     # single-gateway accounting (doc/federation.md).
     reset_federation()
     global_settings.federation_config = ""
+    # Standing-query plane pinned OFF (doc/query_engine.md): this
+    # soak's envelope predates the device diff pass; the plane has its
+    # own soak (scripts/sensor_soak.py).
+    global_settings.queryplane_enabled = False
     global_settings.tpu_entity_capacity = p.entity_capacity
     global_settings.tpu_query_capacity = p.query_capacity
     # Tick cadences tuned for a live soak on a shared CPU box: GLOBAL

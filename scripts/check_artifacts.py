@@ -84,6 +84,15 @@ SCHEMAS: dict[str, set] = {
     "SOAK_ABUSE_*.json": _SOAK_KEYS | {
         "attackers", "edge", "census", "delivery", "rss",
     },
+    # Standing-query plane bench (doc/query_engine.md acceptance
+    # artifact): the 10K+ one-transfer-per-tick scale record, the
+    # host-vs-device crossover curve, the changed-rows fraction with
+    # its O(changed) apply evidence, the 1K-follower per-follower
+    # cost, and the double-entry ledgers.
+    "BENCH_QUERY_*.json": {
+        "metric", "scale", "crossover", "changed_rows",
+        "follower_1k", "ledgers",
+    },
     # Adaptive-partitioning density soak (doc/partitioning.md
     # acceptance artifact): the geometry ledgers, the kill-mid-split
     # record, the steady-state density fold, the final geometry, and
@@ -338,6 +347,72 @@ def _check_density_soak(doc: dict) -> list[str]:
     return errors
 
 
+def _check_query_bench(doc: dict) -> list[str]:
+    """The query bench's acceptance bar beyond key presence
+    (doc/query_engine.md): >= 10K standing queries evaluated with
+    exactly ONE query-plane transfer per tick — counter-verified
+    against `query_plane_transfers_total`, not just asserted — host
+    apply scaling O(changed rows) not O(queries), and the 1K-follower
+    per-follower cost under the PR 7 ~30µs host-loop baseline."""
+    errors: list[str] = []
+    scale = doc.get("scale", {})
+    if scale.get("standing_queries", 0) < 10000:
+        errors.append(
+            f"fewer than 10K standing queries at the scale point "
+            f"({scale.get('standing_queries')})"
+        )
+    ticks = scale.get("ticks")
+    if not ticks or scale.get("transfers") != ticks:
+        errors.append(
+            f"one-transfer-per-tick not proven (ticks={ticks}, "
+            f"transfers={scale.get('transfers')})"
+        )
+    ledgers = doc.get("ledgers", {})
+    for py_key, metric_key in (
+        ("transfers", "query_plane_transfers_total"),
+        ("rows_changed", "query_rows_changed_total"),
+    ):
+        if py_key not in ledgers or metric_key not in ledgers \
+                or ledgers[py_key] != ledgers[metric_key]:
+            errors.append(
+                f"double-entry {py_key} == {metric_key} not proven "
+                f"(ledgers={ledgers})"
+            )
+    if ticks and ledgers.get("transfers") != ticks:
+        errors.append(
+            f"transfer ledger does not counter-verify the tick count "
+            f"(ticks={ticks}, ledger={ledgers.get('transfers')})"
+        )
+    changed = doc.get("changed_rows", {})
+    frac = changed.get("steady_fraction")
+    if frac is None or frac >= 0.5:
+        errors.append(
+            f"steady changed-rows fraction not small ({frac}) — the "
+            "O(changed) premise"
+        )
+    ratio = changed.get("apply_us_per_changed_ratio_10x")
+    if ratio is None or ratio > 3.0:
+        errors.append(
+            "host apply not O(changed): per-changed-row apply cost at "
+            f"10x queries is {ratio}x the small-registry cost (> 3.0)"
+        )
+    fol = doc.get("follower_1k", {})
+    if fol.get("followers", 0) < 1000:
+        errors.append(
+            f"no 1K-follower point recorded ({fol.get('followers')})"
+        )
+    us = fol.get("us_per_follower")
+    baseline = fol.get("baseline_us")
+    if us is None or baseline is None or us >= baseline:
+        errors.append(
+            f"per-follower cost not under the host-loop baseline "
+            f"(us_per_follower={us}, baseline_us={baseline})"
+        )
+    if not doc.get("crossover"):
+        errors.append("no host-vs-device crossover curve recorded")
+    return errors
+
+
 EXTRA_CHECKS = {
     "SOAK_GLOBAL_*.json": _check_global_soak,
     "SOAK_DEVICE_*.json": _check_device_soak,
@@ -345,6 +420,7 @@ EXTRA_CHECKS = {
     "OBS_*.json": _check_obs_soak,
     "SOAK_ABUSE_*.json": _check_abuse_soak,
     "SOAK_SPLIT_*.json": _check_density_soak,
+    "BENCH_QUERY_*.json": _check_query_bench,
 }
 
 
@@ -610,10 +686,49 @@ def check_partitioning_doc(repo: str = REPO) -> list[str]:
     return errors
 
 
+def check_query_engine_doc(repo: str = REPO) -> list[str]:
+    """doc/query_engine.md must document every ``queryplane_*``
+    operator knob core/settings.py declares (a knob added without doc
+    — or documented after removal — is drift), and the docs whose
+    planes the standing-query registry rides must cross-link it:
+    README, doc/observability.md (the query_plane trace stage),
+    doc/partitioning.md (geometry epoch -> query full-resync),
+    doc/device_recovery.md (rebuild -> query epoch resync)."""
+    path = os.path.join(repo, "doc", "query_engine.md")
+    if not os.path.exists(path):
+        return ["doc/query_engine.md missing (standing-query plane "
+                "operator reference)"]
+    text = open(path).read()
+    errors: list[str] = []
+    settings_src = open(
+        os.path.join(repo, "channeld_tpu", "core", "settings.py")
+    ).read()
+    declared = set(re.findall(r"^    (queryplane_[a-z0-9_]+):",
+                              settings_src, re.M))
+    documented = set(re.findall(r"`(queryplane_[a-z0-9_]+)`", text))
+    for name in sorted(declared - documented):
+        errors.append(
+            f"doc/query_engine.md: knob {name!r} is declared in "
+            "core/settings.py but not documented"
+        )
+    for name in sorted(documented - declared):
+        errors.append(
+            f"doc/query_engine.md: documents knob {name!r} with no "
+            "matching declaration in core/settings.py"
+        )
+    for rel in ("README.md", "doc/observability.md",
+                "doc/partitioning.md", "doc/device_recovery.md"):
+        linked = os.path.join(repo, rel)
+        if not os.path.exists(linked) \
+                or "query_engine.md" not in open(linked).read():
+            errors.append(f"{rel}: no cross-link to doc/query_engine.md")
+    return errors
+
+
 def main() -> int:
     errors = (check_artifacts() + check_doc_metrics()
               + check_artifact_metrics() + check_concurrency_doc()
-              + check_partitioning_doc())
+              + check_partitioning_doc() + check_query_engine_doc())
     if errors:
         for e in errors:
             print(f"DRIFT: {e}")

@@ -148,6 +148,10 @@ async def bench_config(name: str, spec: dict, entities: int,
     # assumes the static boot grid (doc/partitioning.md);
     # scripts/density_soak.py is the partitioning plane's own soak.
     global_settings.partition_enabled = False
+    # Standing-query plane pinned OFF (doc/query_engine.md): this
+    # bench's envelope predates the device diff pass; the plane has its
+    # own bench (scripts/query_bench.py).
+    global_settings.queryplane_enabled = False
     global_settings.tpu_entity_capacity = max(1 << 10, 1 << (
         max(entities - 1, 1).bit_length() + 1))
     global_settings.tpu_query_capacity = 64

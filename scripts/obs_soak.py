@@ -246,6 +246,10 @@ async def run_live_phase(p: ObsSoakParams, dump_dir: str) -> dict:
     # Ladder pinned L0 like the trace soak: boot-compile stalls on a
     # loaded CPU box would climb to L3 and refuse the client fleet.
     global_settings.overload_enabled = False
+    # Standing-query plane pinned OFF (doc/query_engine.md): this
+    # soak's envelope predates the device diff pass; the plane has its
+    # own soak (scripts/sensor_soak.py).
+    global_settings.queryplane_enabled = False
     global_settings.tpu_entity_capacity = 256
     global_settings.tpu_query_capacity = 32
     global_settings.channel_settings = {

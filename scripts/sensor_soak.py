@@ -1,0 +1,265 @@
+"""Standing-query plane churn soak (doc/query_engine.md).
+
+A live single-gateway world where ALL THREE registration scopes run at
+once — entity follows, real client `UpdateSpatialInterestMessage`
+queries driven through the actual handler, and server sensors (one with
+a callback) — under connection churn, continuous movement, a mid-run
+device-guard rebuild, and a PR 18 geometry epoch. The soak proves the
+plane's books with exact double-entry accounting:
+
+- exactly ONE query-plane device→host transfer per tick, three-way
+  counter-verified (bench loop count == plane python ledger ==
+  `query_plane_transfers_total` delta);
+- `query_rows_changed_total` / `query_full_resyncs_total` equal to the
+  plane's python ledgers;
+- `query_pass_ms` observed once per tick;
+- the `standing_queries{scope}` gauges equal to a recount of the live
+  registry;
+- churned connections' device rows reaped (bounded-registry
+  discipline), live clients' host-path answer a subset of their
+  device-driven subscriptions.
+
+Smoke-scale by default (<60s on CPU); pass --out to keep the JSON
+report. Exit code 0 iff every invariant held.
+
+Run:
+  python scripts/sensor_soak.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ticks", type=int, default=90)
+    ap.add_argument("--entities", type=int, default=256)
+    ap.add_argument("--follows", type=int, default=48)
+    ap.add_argument("--clients", type=int, default=48)
+    ap.add_argument("--sensors", type=int, default=24)
+    ap.add_argument("--out", type=str, default="")
+    args = ap.parse_args()
+
+    import channeld_tpu.core.connection as connection_mod
+    from helpers import StubConnection, fresh_runtime
+    from channeld_tpu.chaos import invariants
+    from channeld_tpu.chaos.invariants import InvariantChecker
+    from channeld_tpu.core.message import MessageContext
+    from channeld_tpu.core.settings import global_settings
+    from channeld_tpu.core.subscription import subscribe_to_channel
+    from channeld_tpu.core.types import ConnectionType, MessageType
+    from channeld_tpu.models.sim import register_sim_types
+    from channeld_tpu.ops.spatial_ops import AOI_BOX, AOI_SPHERE
+    from channeld_tpu.protocol import control_pb2, spatial_pb2
+    from channeld_tpu.spatial.controller import (
+        SpatialInfo,
+        set_spatial_controller,
+    )
+    from channeld_tpu.spatial.messages import handle_update_spatial_interest
+    from channeld_tpu.spatial.tpu_controller import TPUSpatialController
+
+    fresh_runtime()
+    register_sim_types()
+    global_settings.tpu_entity_capacity = max(512, args.entities * 2)
+    global_settings.tpu_query_capacity = 512
+    ctl = TPUSpatialController()
+    ctl.load_config(dict(
+        WorldOffsetX=0, WorldOffsetZ=0, GridWidth=100, GridHeight=100,
+        GridCols=8, GridRows=8, ServerCols=1, ServerRows=1,
+        ServerInterestBorderSize=1,
+    ))
+    set_spatial_controller(ctl)
+    server = StubConnection(1, ConnectionType.SERVER)
+    ctx = MessageContext(
+        msg_type=MessageType.CREATE_CHANNEL,
+        msg=control_pb2.CreateChannelMessage(),
+        connection=server,
+    )
+    channels = ctl.create_channels(ctx)
+    for ch in channels:
+        subscribe_to_channel(server, ch, None)
+    plane = ctl.queryplane
+
+    rng = np.random.default_rng(1919)
+    world = 800.0
+
+    def rand_xz():
+        return (float(rng.uniform(0, world)), float(rng.uniform(0, world)))
+
+    eids = []
+    for i in range(args.entities):
+        eid = 0xA0000 + i
+        x, z = rand_xz()
+        ctl.track_entity(eid, SpatialInfo(x, 0.0, z))
+        eids.append(eid)
+
+    next_cid = [100]
+
+    def new_conn():
+        conn = StubConnection(next_cid[0], ConnectionType.CLIENT)
+        next_cid[0] += 1
+        connection_mod._all_connections[conn.id] = conn
+        return conn
+
+    def send_query(conn, build):
+        msg = spatial_pb2.UpdateSpatialInterestMessage(connId=conn.id)
+        build(msg.query)
+        handle_update_spatial_interest(MessageContext(
+            msg_type=MessageType.UPDATE_SPATIAL_INTEREST, msg=msg,
+            connection=conn,
+        ))
+
+    def sphere_at(x, z, r=120.0):
+        def build(q):
+            q.sphereAOI.center.x, q.sphereAOI.center.z = x, z
+            q.sphereAOI.radius = r
+        return build
+
+    # ---- registrations: all three scopes -------------------------------
+    for i in range(args.follows):
+        conn = new_conn()
+        ctl.register_follow_interest(conn, eids[i % len(eids)], AOI_SPHERE,
+                                     extent=(150.0, 0.0))
+    query_clients = []
+    for _ in range(args.clients):
+        conn = new_conn()
+        send_query(conn, sphere_at(*rand_xz()))
+        query_clients.append(conn)
+    callback_hits = []
+    ctl.register_sensor("cb", kind=AOI_SPHERE, center=(world / 2, world / 2),
+                        extent=(200.0, 0.0),
+                        callback=lambda key, cells:
+                        callback_hits.append(len(cells)))
+    for i in range(args.sensors - 1):
+        x, z = rand_xz()
+        ctl.register_sensor(f"s{i}", kind=AOI_BOX if i % 2 else AOI_SPHERE,
+                            center=(x, z), extent=(90.0, 140.0))
+
+    def drain():
+        for ch in channels:
+            ch.tick_once(0)
+
+    # ---- baseline AFTER registration, BEFORE the measured window -------
+    base = invariants.scrape()
+    t_ledger0 = plane.ledgers["transfers"]
+    r_ledger0 = plane.ledgers["rows_changed"]
+    f_ledger0 = plane.ledgers["full_resyncs"]
+
+    n_move = max(1, args.entities // 10)
+    closed = 0
+    rebuild_tick = args.ticks // 3
+    epoch_tick = (2 * args.ticks) // 3
+    for t in range(args.ticks):
+        for eid in rng.choice(eids, n_move, replace=False).tolist():
+            x, z = rand_xz()
+            ctl.track_entity(eid, SpatialInfo(x, 0.0, z))
+        if t % 7 == 3 and query_clients:
+            # churn: one query client leaves, a fresh one arrives
+            gone = query_clients.pop(0)
+            gone.close()
+            closed += 1
+            conn = new_conn()
+            send_query(conn, sphere_at(*rand_xz()))
+            query_clients.append(conn)
+        if t % 11 == 5 and query_clients:
+            # a live client re-issues a moved query (update-in-place)
+            send_query(query_clients[-1], sphere_at(*rand_xz()))
+        if t == rebuild_tick:
+            # device-guard recovery path: baseline destroyed, full resync
+            ctl.engine.rebuild_device_state(ctl.rebuild_seed_cells())
+        if t == epoch_tick:
+            # PR 18 geometry epoch: micro-grid re-rasterized
+            ctl.engine.apply_grid(ctl.engine.grid, ctl.rebuild_seed_cells())
+        ctl.tick()
+        drain()
+
+    # ---- the books -----------------------------------------------------
+    d = invariants.delta(invariants.scrape(), base)
+    inv = InvariantChecker()
+    transfers = plane.ledgers["transfers"] - t_ledger0
+    inv.expect_equal("one_transfer_per_tick", transfers, args.ticks)
+    inv.expect_equal(
+        "transfers_ledger_matches_metric", transfers,
+        invariants.sample_total(d, "query_plane_transfers_total"),
+    )
+    inv.expect_equal(
+        "rows_changed_ledger_matches_metric",
+        plane.ledgers["rows_changed"] - r_ledger0,
+        invariants.sample_total(d, "query_rows_changed_total"),
+    )
+    resyncs = plane.ledgers["full_resyncs"] - f_ledger0
+    inv.expect_equal(
+        "full_resyncs_ledger_matches_metric", resyncs,
+        invariants.sample_total(d, "query_full_resyncs_total"),
+    )
+    inv.expect_equal("rebuild_and_epoch_each_full_resynced", resyncs, 2)
+    inv.expect_equal(
+        "pass_timed_every_tick", args.ticks,
+        invariants.sample_total(d, "query_pass_ms_count"),
+    )
+    inv.expect_equal("churned_rows_reaped", plane.ledgers["reaped"], closed)
+    # gauge == a live recount of the registry, per scope
+    scope_counts = {"follow": 0, "client": 0, "sensor": 0}
+    for e in plane._entries.values():
+        scope_counts[e["scope"]] += 1
+    for scope, n in scope_counts.items():
+        inv.expect_equal(
+            f"standing_queries_gauge_matches_registry_{scope}",
+            invariants.sample_total(None, "standing_queries", scope=scope),
+            n,
+        )
+    inv.expect_gt("sensor_callback_fired", len(callback_hits), 0)
+    inv.expect_gt("rows_flowed", plane.ledgers["rows_changed"] - r_ledger0, 0)
+    # live clients: the host-path answer must be a subset of what the
+    # device plane subscribed them to (device masks are a superset of
+    # host half-step sampling — doc/query_engine.md)
+    subs_ok = True
+    for conn in query_clients[-8:]:
+        entry = plane._entries.get(conn.id)
+        if entry is None:
+            subs_ok = False
+            break
+        q = spatial_pb2.SpatialInterestQuery()
+        q.sphereAOI.center.x, q.sphereAOI.center.z = entry["center"]
+        q.sphereAOI.radius = entry["extent"][0]
+        host = set(ctl.query_channel_ids(q))
+        if not host.issubset(set(conn.spatial_subscriptions)):
+            subs_ok = False
+            break
+    inv.check("client_query_subs_superset_of_host", subs_ok)
+    inv.check(
+        "closed_clients_hold_no_rows",
+        not any(k in plane._entries
+                for k in range(100, next_cid[0])
+                if (c := connection_mod._all_connections.get(k)) is not None
+                and c.is_closing()),
+    )
+
+    report = {
+        "soak": "sensor_churn",
+        "ticks": args.ticks,
+        "standing_queries": plane.count(),
+        "churned_clients": closed,
+        "ledgers": dict(plane.ledgers),
+        **inv.summary(),
+    }
+    print(json.dumps(report, indent=1))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
+            f.write("\n")
+    return 0 if inv.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -219,6 +219,10 @@ async def run_live_phase(p: TraceSoakParams, dump_dir: str) -> dict:
     # `overload` stage is still measured: governor.update runs, and
     # tick_budget anomalies still fire, with the ladder disarmed.
     global_settings.overload_enabled = False
+    # Standing-query plane pinned OFF (doc/query_engine.md): this
+    # soak's envelope predates the device diff pass; the plane has its
+    # own soak (scripts/sensor_soak.py).
+    global_settings.queryplane_enabled = False
     global_settings.tpu_entity_capacity = 256
     global_settings.tpu_query_capacity = 32
     global_settings.channel_settings = {

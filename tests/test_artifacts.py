@@ -494,3 +494,93 @@ def test_missing_concurrency_doc_is_flagged(tmp_path):
     (tmp_path / "doc").mkdir()
     errors = check_artifacts.check_concurrency_doc(str(tmp_path))
     assert errors and "missing" in errors[0]
+
+
+def _query_bench_doc():
+    return {
+        "metric": "standing_queries_one_transfer_per_tick",
+        "scale": {"standing_queries": 10240, "ticks": 200,
+                  "transfers": 200},
+        "crossover": [{"queries": 256, "host_ms": 1.2, "device_ms": 0.9}],
+        "changed_rows": {"steady_fraction": 0.02,
+                         "apply_us_per_changed_ratio_10x": 1.3},
+        "follower_1k": {"followers": 1024, "us_per_follower": 4.0,
+                        "baseline_us": 30.0},
+        "ledgers": {"transfers": 200, "query_plane_transfers_total": 200,
+                    "rows_changed": 5000,
+                    "query_rows_changed_total": 5000},
+    }
+
+
+def test_query_bench_schema_gate(tmp_path):
+    """BENCH_QUERY_*.json extra checks (doc/query_engine.md): a clean
+    artifact passes; under-scale query counts, a transfer count off the
+    tick count, a ledger!=metric mismatch, a large changed fraction, a
+    non-O(changed) apply ratio, and a per-follower cost at/over the
+    host-loop baseline are each flagged."""
+    import json
+
+    path = tmp_path / "BENCH_QUERY_r99.json"
+    path.write_text(json.dumps(_query_bench_doc()))
+    assert check_artifacts.check_artifacts(str(tmp_path)) == []
+
+    doc = _query_bench_doc()
+    doc["scale"]["standing_queries"] = 4096
+    path.write_text(json.dumps(doc))
+    assert any("fewer than 10K standing queries" in e
+               for e in check_artifacts.check_artifacts(str(tmp_path)))
+
+    doc = _query_bench_doc()
+    doc["scale"]["transfers"] = 201
+    path.write_text(json.dumps(doc))
+    assert any("one-transfer-per-tick not proven" in e
+               for e in check_artifacts.check_artifacts(str(tmp_path)))
+
+    doc = _query_bench_doc()
+    doc["ledgers"]["query_plane_transfers_total"] = 199
+    path.write_text(json.dumps(doc))
+    assert any("double-entry transfers" in e
+               for e in check_artifacts.check_artifacts(str(tmp_path)))
+
+    doc = _query_bench_doc()
+    doc["changed_rows"]["apply_us_per_changed_ratio_10x"] = 8.0
+    path.write_text(json.dumps(doc))
+    assert any("not O(changed)" in e
+               for e in check_artifacts.check_artifacts(str(tmp_path)))
+
+    doc = _query_bench_doc()
+    doc["follower_1k"]["us_per_follower"] = 31.0
+    path.write_text(json.dumps(doc))
+    assert any("not under the host-loop baseline" in e
+               for e in check_artifacts.check_artifacts(str(tmp_path)))
+
+
+def test_query_engine_doc_matches_declared_knobs():
+    """doc/query_engine.md documents exactly the queryplane_* knobs
+    core/settings.py declares, and the planes the standing-query
+    registry rides (README, observability, partitioning, device
+    recovery) cross-link it."""
+    assert check_artifacts.check_query_engine_doc() == []
+
+
+def test_query_engine_doc_drift_is_flagged(tmp_path):
+    import shutil
+
+    doc_dir = tmp_path / "doc"
+    doc_dir.mkdir()
+    core = tmp_path / "channeld_tpu" / "core"
+    core.mkdir(parents=True)
+    shutil.copy(os.path.join(REPO, "channeld_tpu", "core", "settings.py"),
+                core / "settings.py")
+
+    errors = check_artifacts.check_query_engine_doc(str(tmp_path))
+    assert errors and "missing" in errors[0]
+
+    (doc_dir / "query_engine.md").write_text(
+        "# x\n\n`queryplane_enabled` and the phantom "
+        "`queryplane_ghost_knob`.\n"
+    )
+    errors = check_artifacts.check_query_engine_doc(str(tmp_path))
+    assert any("queryplane_ghost_knob" in e for e in errors)
+    assert any("queryplane_rows_max" in e for e in errors)
+    assert sum("no cross-link" in e for e in errors) == 4

@@ -644,11 +644,14 @@ def test_l2_defers_follower_interest_every_other_tick():
     ctl.register_follow_interest(player, eid, AOI_SPHERE, extent=(40.0, 0.0))
 
     governor.level = int(OverloadLevel.L2)
-    before = governor.shed_counts.get("follow_interest_defer", 0)
+    # Follower interest rides the standing-query plane now
+    # (doc/query_engine.md): the deferred apply pass sheds under
+    # `query_apply_defer`, one count per deferred standing row.
+    before = governor.shed_counts.get("query_apply_defer", 0)
     ctl.tick()  # skipped
     ctl.tick()  # applied
     ctl.tick()  # skipped
-    assert governor.shed_counts["follow_interest_defer"] == before + 2
+    assert governor.shed_counts["query_apply_defer"] == before + 2
 
 
 # ---- admission decision surface -------------------------------------------

@@ -1,0 +1,604 @@
+"""Standing-query plane (doc/query_engine.md): device/host parity for
+every AOI shape, the changed-rows diff/compaction protocol, and the
+interaction matrix — guard rebuilds, geometry epochs, WAL replay,
+snapshot/adoption restore, overload halving, connection churn, handler
+hardening."""
+
+import math
+
+import numpy as np
+import pytest
+
+import channeld_tpu.core.connection as connection_mod
+from channeld_tpu.core import metrics
+from channeld_tpu.core.message import MessageContext
+from channeld_tpu.core.overload import OverloadLevel, governor
+from channeld_tpu.core.settings import global_settings
+from channeld_tpu.core.subscription import subscribe_to_channel
+from channeld_tpu.core.types import ConnectionType, MessageType
+from channeld_tpu.models.sim import register_sim_types
+from channeld_tpu.protocol import control_pb2, spatial_pb2
+from channeld_tpu.spatial.controller import SpatialInfo, set_spatial_controller
+from channeld_tpu.spatial.tpu_controller import TPUSpatialController
+
+from helpers import StubConnection, fresh_runtime
+
+
+@pytest.fixture(autouse=True)
+def runtime():
+    gch = fresh_runtime()
+    register_sim_types()
+    yield gch
+    governor.level = OverloadLevel.L0
+
+
+def make_world(**extra_cfg):
+    global_settings.tpu_entity_capacity = 64
+    global_settings.tpu_query_capacity = 16
+    ctl = TPUSpatialController()
+    ctl.load_config(
+        dict(WorldOffsetX=0, WorldOffsetZ=0, GridWidth=100, GridHeight=100,
+             GridCols=4, GridRows=1, ServerCols=1, ServerRows=1,
+             ServerInterestBorderSize=1, **extra_cfg)
+    )
+    set_spatial_controller(ctl)
+    server = StubConnection(1, ConnectionType.SERVER)
+    ctx = MessageContext(
+        msg_type=MessageType.CREATE_CHANNEL,
+        msg=control_pb2.CreateChannelMessage(),
+        connection=server,
+    )
+    channels = ctl.create_channels(ctx)
+    for ch in channels:
+        subscribe_to_channel(server, ch, None)
+    return ctl, server, channels
+
+
+def run_ticks(ctl, channels, n=1):
+    """One device pass + the channel drains that land the queued
+    sub/unsub messages apply_interest_diff produced."""
+    for _ in range(n):
+        ctl.tick()
+        for ch in channels:
+            ch.tick_once(0)
+
+
+def make_client(cid=9):
+    client = StubConnection(cid, ConnectionType.CLIENT)
+    connection_mod._all_connections[client.id] = client
+    return client
+
+
+# ---------------------------------------------------------------------------
+# device/host parity
+# ---------------------------------------------------------------------------
+
+
+def test_aoi_masks_match_exact_overlap_oracle():
+    """Property: the device's [Q,C] interest masks equal an independent
+    exact cell-rectangle-overlap oracle for sphere/box/cone, and the
+    damping distance matches the ceil(center-dist / diagonal) metric
+    (0 for the containing cell)."""
+    import jax.numpy as jnp
+
+    from channeld_tpu.ops.spatial_ops import (
+        AOI_BOX, AOI_CONE, AOI_SPHERE, GridSpec, QuerySet, aoi_masks,
+    )
+
+    grid = GridSpec(offset_x=0.0, offset_z=0.0, cell_w=100.0, cell_h=100.0,
+                    cols=6, rows=4)
+    rng = np.random.default_rng(11)
+    q = 24
+    kinds = np.array([AOI_SPHERE, AOI_BOX, AOI_CONE] * (q // 3), np.int32)
+    centers = rng.uniform(-50, 650, (q, 2)).astype(np.float32)
+    extents = rng.uniform(10, 260, (q, 2)).astype(np.float32)
+    theta = rng.uniform(0, 2 * np.pi, q)
+    dirs = np.stack([np.cos(theta), np.sin(theta)], 1).astype(np.float32)
+    angles = rng.uniform(0.1, 1.2, q).astype(np.float32)
+    qs = QuerySet(jnp.asarray(kinds), jnp.asarray(centers),
+                  jnp.asarray(extents), jnp.asarray(dirs),
+                  jnp.asarray(angles))
+    hit = np.asarray(aoi_masks(grid, qs)[0])
+    dist = np.asarray(aoi_masks(grid, qs)[1])
+
+    for qi in range(q):
+        for cell in range(grid.num_cells):
+            cx = (cell % grid.cols + 0.5) * grid.cell_w
+            cz = (cell // grid.cols + 0.5) * grid.cell_h
+            dx = abs(float(centers[qi, 0]) - cx)
+            dz = abs(float(centers[qi, 1]) - cz)
+            gap = math.hypot(max(dx - 50.0, 0.0), max(dz - 50.0, 0.0))
+            if kinds[qi] == AOI_SPHERE:
+                want = gap <= extents[qi, 0]
+            elif kinds[qi] == AOI_BOX:
+                want = (dx <= extents[qi, 0] + 50.0
+                        and dz <= extents[qi, 1] + 50.0)
+            else:
+                tx = cx - float(centers[qi, 0])
+                tz = cz - float(centers[qi, 1])
+                ln = max(math.hypot(tx, tz), 1e-9)
+                cos = (tx * dirs[qi, 0] + tz * dirs[qi, 1]) / ln
+                want = gap <= extents[qi, 0] and (
+                    cos >= math.cos(angles[qi]) or gap <= 0.0)
+            assert hit[qi, cell] == want, (qi, cell, kinds[qi])
+            if not want:
+                continue
+            cd = math.hypot(float(centers[qi, 0]) - cx,
+                            float(centers[qi, 1]) - cz)
+            ratio = cd / grid.diagonal
+            if abs(ratio - round(ratio)) < 1e-4:
+                continue  # f32/f64 ceil boundary; not a semantic case
+            want_d = 0 if gap <= 0.0 else math.ceil(ratio)
+            assert dist[qi, cell] == want_d, (qi, cell)
+
+
+def test_device_interest_superset_of_host_sampling():
+    """The host path samples the query at half-cell steps and can miss
+    grazed cells; the device rasterizes exact overlap. For the same
+    sphere and box every host-found leaf must be device-found too (with
+    dist 0 on the containing leaf)."""
+    import jax.numpy as jnp
+
+    from channeld_tpu.ops.spatial_ops import (
+        AOI_BOX, AOI_SPHERE, QuerySet, aoi_masks,
+    )
+
+    ctl, _server, _channels = make_world()
+
+    def device_leaves(kind, center, extent):
+        qs = QuerySet(
+            jnp.asarray([kind], jnp.int32),
+            jnp.asarray([center], jnp.float32),
+            jnp.asarray([extent], jnp.float32),
+            jnp.asarray([[1.0, 0.0]], jnp.float32),
+            jnp.asarray([0.0], jnp.float32),
+        )
+        hit, dist = aoi_masks(ctl.engine.grid, qs)
+        hit = np.asarray(hit)[0]
+        dist = np.asarray(dist)[0]
+        desired = {int(c): int(dist[c]) for c in np.flatnonzero(hit)}
+        return ctl.collapse_micro_cells(desired)
+
+    q = spatial_pb2.SpatialInterestQuery()
+    q.sphereAOI.center.x = 120.0
+    q.sphereAOI.center.z = 40.0
+    q.sphereAOI.radius = 150.0
+    host = ctl.query_channel_ids(q)
+    dev = device_leaves(AOI_SPHERE, (120.0, 40.0), (150.0, 0.0))
+    assert host and set(host) <= set(dev)
+    containing = ctl.get_channel_id(SpatialInfo(120.0, 0.0, 40.0))
+    assert dev[containing] == 0
+
+    q = spatial_pb2.SpatialInterestQuery()
+    q.boxAOI.center.x = 250.0
+    q.boxAOI.center.z = 50.0
+    q.boxAOI.extent.x = 120.0
+    q.boxAOI.extent.z = 30.0
+    host = ctl.query_channel_ids(q)
+    dev = device_leaves(AOI_BOX, (250.0, 50.0), (120.0, 30.0))
+    assert host and set(host) <= set(dev)
+
+
+def test_client_spots_query_matches_host_exactly():
+    """Spots are host-rasterized points, not sampled geometry: the
+    standing row's applied interest must equal query_channel_ids
+    byte-for-byte (cells AND per-spot dists)."""
+    ctl, _server, channels = make_world()
+    client = make_client()
+
+    q = spatial_pb2.SpatialInterestQuery()
+    for (x, z), d in (((50.0, 50.0), 0), ((350.0, 50.0), 2)):
+        s = q.spotsAOI.spots.add()
+        s.x, s.y, s.z = x, 0.0, z
+        q.spotsAOI.dists.append(d)
+    host = ctl.query_channel_ids(q)
+
+    assert ctl.queryplane.register_client_spots(
+        client, [(50.0, 50.0), (350.0, 50.0)], [0, 2])
+    run_ticks(ctl, channels, 2)
+    assert set(client.spatial_subscriptions) == set(host)
+
+
+# ---------------------------------------------------------------------------
+# the diff/compaction protocol
+# ---------------------------------------------------------------------------
+
+
+def test_diff_reconstruction_property():
+    """Property: replaying every changed row against a host mirror
+    reconstructs the device's full interest/dist planes exactly, tick
+    after tick (the mirror protocol's correctness)."""
+    import jax.numpy as jnp
+
+    from channeld_tpu.ops.spatial_ops import diff_query_masks, parse_query_blob
+
+    rng = np.random.default_rng(3)
+    q, c = 7, 13
+    prev_i = jnp.zeros((q, c), bool)
+    prev_d = jnp.zeros((q, c), jnp.int32)
+    recon_i = np.zeros((q, c), bool)
+    recon_d = np.zeros((q, c), np.int32)
+    for _ in range(6):
+        interest = jnp.asarray(rng.random((q, c)) < 0.3)
+        dist = jnp.asarray(rng.integers(0, 4, (q, c)), jnp.int32)
+        blob, prev_i, prev_d = diff_query_masks(
+            prev_i, prev_d, interest, dist, 4096)
+        count, rows = parse_query_blob(np.asarray(blob))
+        assert count <= q * c
+        for qi, ci, d in rows[:count].tolist():
+            if d < 0:
+                recon_i[qi, ci] = False
+            else:
+                recon_i[qi, ci] = True
+                recon_d[qi, ci] = d
+        np.testing.assert_array_equal(recon_i, np.asarray(interest))
+        np.testing.assert_array_equal(recon_d[recon_i],
+                                      np.asarray(dist)[recon_i])
+
+
+def test_diff_overflow_rediffs_until_drained():
+    """Overflow contract: rows past the budget keep their previous
+    baseline on device, so repeating the same masks drains the backlog
+    a budget's worth per tick — nothing is ever lost, and count always
+    reports the true backlog."""
+    import jax.numpy as jnp
+
+    from channeld_tpu.ops.spatial_ops import diff_query_masks, parse_query_blob
+
+    q, c = 3, 8
+    interest = jnp.asarray(np.arange(q * c).reshape(q, c) % 2 == 0)
+    dist = jnp.asarray(np.ones((q, c)), jnp.int32)
+    total = int(np.asarray(interest).sum())
+    prev_i = jnp.zeros((q, c), bool)
+    prev_d = jnp.zeros((q, c), jnp.int32)
+    recon_i = np.zeros((q, c), bool)
+    seen = 0
+    for step in range((total + 3) // 4 + 1):
+        blob, prev_i, prev_d = diff_query_masks(
+            prev_i, prev_d, interest, dist, 4)
+        count, rows = parse_query_blob(np.asarray(blob))
+        assert count == total - seen
+        emitted = rows[: min(count, len(rows))]
+        for qi, ci, d in emitted.tolist():
+            assert d >= 0
+            assert not recon_i[qi, ci], "row emitted twice"
+            recon_i[qi, ci] = True
+        seen += len(emitted.tolist())
+        if count == 0:
+            break
+    np.testing.assert_array_equal(recon_i, np.asarray(interest))
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: follow / client / sensor rows through the engine tick
+# ---------------------------------------------------------------------------
+
+
+def test_follow_interest_flows_through_plane():
+    from channeld_tpu.ops.spatial_ops import AOI_SPHERE
+
+    ctl, _server, channels = make_world()
+    client = make_client()
+    eid = 7001
+    ctl.track_entity(eid, SpatialInfo(50.0, 0.0, 50.0))
+    ctl.register_follow_interest(client, eid, AOI_SPHERE, extent=(80.0, 0.0))
+
+    t0 = metrics.query_plane_transfers._value.get()
+    c0 = metrics.query_rows_changed._value.get()
+    run_ticks(ctl, channels, 2)
+    assert client.spatial_subscriptions
+    plane = ctl.queryplane
+    assert plane.count() == 1
+    assert metrics.standing_queries.labels(scope="follow")._value.get() == 1
+    # One transfer per tick, double-entried (the metric is process-wide,
+    # the ledger per plane: compare deltas).
+    assert plane.ledgers["transfers"] == 2
+    assert metrics.query_plane_transfers._value.get() - t0 == 2
+    assert metrics.query_rows_changed._value.get() - c0 == \
+        plane.ledgers["rows_changed"]
+
+    # The entity moves within the world: the standing row re-centers and
+    # the device re-diffs — the interest set follows with no new message.
+    before = dict(client.spatial_subscriptions)
+    ctl.track_entity(eid, SpatialInfo(350.0, 0.0, 50.0))
+    run_ticks(ctl, channels, 2)
+    assert client.spatial_subscriptions != before
+    assert ctl.get_channel_id(SpatialInfo(350.0, 0.0, 50.0)) \
+        in client.spatial_subscriptions
+
+
+def test_sensor_polls_and_callback_fires():
+    ctl, _server, channels = make_world()
+    seen = []
+    key = ctl.register_sensor(
+        "radar", center=(50.0, 50.0), extent=(120.0, 0.0),
+        callback=lambda k, cells: seen.append((k, cells)),
+    )
+    assert key is not None and key >= (1 << 30)
+    run_ticks(ctl, channels, 2)
+    cells = ctl.queryplane.sensor_cells(key)
+    assert cells
+    assert seen and seen[-1] == (key, cells)
+    assert metrics.standing_queries.labels(scope="sensor")._value.get() == 1
+
+    # A raising callback is contained: the tick keeps running and the
+    # polled cells still refresh.
+    ctl.register_sensor(
+        "broken", center=(250.0, 50.0), extent=(80.0, 0.0),
+        callback=lambda k, cells: (_ for _ in ()).throw(RuntimeError("x")),
+    )
+    run_ticks(ctl, channels, 2)
+    assert ctl.queryplane.sensor_cells(key) == cells
+
+
+def test_client_query_clears_on_empty_and_row_reuse_full_emits():
+    """Deregistration unsubscribes synchronously; a NEW registration
+    that reuses the freed engine row must full-emit its mask (the
+    zeroed-baseline contract) — including cells the old query also
+    covered."""
+    from channeld_tpu.ops.spatial_ops import AOI_SPHERE
+
+    ctl, _server, channels = make_world()
+    a = make_client(9)
+    plane = ctl.queryplane
+    assert plane.register_client(a, AOI_SPHERE, (150.0, 50.0), (120.0, 0.0))
+    row_a = ctl.engine.query_row_of_conn(a.id)
+    run_ticks(ctl, channels, 2)
+    assert a.spatial_subscriptions
+
+    plane.deregister(a.id)
+    for ch in channels:  # land the queued unsubs; no device tick needed
+        ch.tick_once(0)
+    assert a.spatial_subscriptions == {}
+    assert ctl.engine.query_row_of_conn(a.id) is None
+
+    b = make_client(10)
+    assert plane.register_client(b, AOI_SPHERE, (150.0, 50.0), (120.0, 0.0))
+    assert ctl.engine.query_row_of_conn(b.id) == row_a  # row reused
+    run_ticks(ctl, channels, 2)
+    # Identical geometry: b must see every cell a saw, overlap included.
+    host = {}
+    q = spatial_pb2.SpatialInterestQuery()
+    q.sphereAOI.center.x, q.sphereAOI.center.z = 150.0, 50.0
+    q.sphereAOI.radius = 120.0
+    host = ctl.query_channel_ids(q)
+    assert set(host) <= set(b.spatial_subscriptions)
+
+
+# ---------------------------------------------------------------------------
+# interaction matrix: rebuilds, geometry epochs, overload, churn
+# ---------------------------------------------------------------------------
+
+
+def test_guard_rebuild_full_resyncs_without_losing_subs():
+    from channeld_tpu.ops.spatial_ops import AOI_SPHERE
+
+    ctl, _server, channels = make_world()
+    client = make_client()
+    eid = 7002
+    ctl.track_entity(eid, SpatialInfo(50.0, 0.0, 50.0))
+    ctl.register_follow_interest(client, eid, AOI_SPHERE, extent=(80.0, 0.0))
+    run_ticks(ctl, channels, 2)
+    before = dict(client.spatial_subscriptions)
+    assert before
+    plane = ctl.queryplane
+    r0 = metrics.query_full_resyncs._value.get()
+
+    ctl.engine.rebuild_device_state(ctl.rebuild_seed_cells())
+    run_ticks(ctl, channels, 2)
+
+    assert plane.ledgers["full_resyncs"] == 1
+    assert metrics.query_full_resyncs._value.get() - r0 == 1
+    # Zero lost, zero duplicated: the device's full re-emission against
+    # its fresh baseline reconstructs the exact same interest set.
+    assert client.spatial_subscriptions == before
+
+
+def test_geometry_epoch_reevaluates_standing_queries():
+    """apply_grid (the adaptive-partitioning rebuild) bumps the query
+    epoch: the plane full-resyncs and re-applies every registration —
+    spots rows re-rasterize against the new grid too."""
+    ctl, _server, channels = make_world()
+    client = make_client()
+    plane = ctl.queryplane
+    assert plane.register_client_spots(client, [(50.0, 50.0)], [1])
+    run_ticks(ctl, channels, 2)
+    before = dict(client.spatial_subscriptions)
+    assert before
+
+    ctl.engine.apply_grid(ctl.engine.grid, ctl.rebuild_seed_cells())
+    run_ticks(ctl, channels, 2)
+    assert plane.ledgers["full_resyncs"] == 1
+    assert client.spatial_subscriptions == before
+
+
+def test_overload_l2_halves_apply_cadence_but_always_consumes():
+    from channeld_tpu.ops.spatial_ops import AOI_SPHERE
+
+    ctl, _server, channels = make_world()
+    client = make_client()
+    eid = 7003
+    ctl.track_entity(eid, SpatialInfo(50.0, 0.0, 50.0))
+    ctl.register_follow_interest(client, eid, AOI_SPHERE, extent=(80.0, 0.0))
+    plane = ctl.queryplane
+
+    governor.level = OverloadLevel.L2
+    run_ticks(ctl, channels, 4)
+    # Apply alternated (2 of 4 deferred, counted as sheds)...
+    assert governor.shed_counts.get("query_apply_defer") == 2
+    # ...but the consume pass drained every tick regardless.
+    assert plane.ledgers["transfers"] == 4
+    # The deferred deltas were not lost: interest landed.
+    assert client.spatial_subscriptions
+
+
+def test_connection_churn_reaps_device_rows():
+    from channeld_tpu.ops.spatial_ops import AOI_SPHERE
+
+    ctl, _server, channels = make_world()
+    a, b = make_client(9), make_client(10)
+    plane = ctl.queryplane
+    assert plane.register_client(a, AOI_SPHERE, (150.0, 50.0), (90.0, 0.0))
+    assert plane.register_client(b, AOI_SPHERE, (250.0, 50.0), (90.0, 0.0))
+    run_ticks(ctl, channels, 2)
+    assert plane.count() == 2
+
+    a.close()
+    run_ticks(ctl, channels, 1)
+    assert plane.count() == 1
+    assert plane.ledgers["reaped"] == 1
+    assert ctl.engine.query_row_of_conn(a.id) is None
+    # The survivor's row is untouched.
+    assert b.spatial_subscriptions
+
+
+# ---------------------------------------------------------------------------
+# durability: WAL replay, snapshot extras, shard adoption
+# ---------------------------------------------------------------------------
+
+
+def test_wal_journal_and_boot_replay_restores_sensors(tmp_path):
+    from channeld_tpu.core.wal import boot_replay, read_wal_records, wal
+    from channeld_tpu.ops.spatial_ops import AOI_SPHERE
+
+    ctl, _server, _channels = make_world()
+    global_settings.wal_fsync_ms = 1.0
+    path = str(tmp_path / "gw.wal")
+    wal.start(path)
+
+    key = ctl.register_sensor("watch", center=(50.0, 50.0),
+                              extent=(120.0, 0.0))
+    gone = ctl.register_sensor("gone", center=(250.0, 50.0),
+                               extent=(80.0, 0.0))
+    ctl.queryplane.deregister(gone)
+    client = make_client()
+    assert ctl.queryplane.register_client(
+        client, AOI_SPHERE, (150.0, 50.0), (90.0, 0.0))
+    assert wal.flush()
+    wal.stop()
+
+    records, torn = read_wal_records(path)
+    assert not torn
+    qrecs = [r for r in records if r.kind == "query"]
+    assert [(r.op, r.queryKey) for r in qrecs] == [
+        ("set", key), ("set", gone), ("remove", gone), ("set", client.id),
+    ]
+
+    # Fresh gateway, same WAL: the sensor re-registers key-preserved;
+    # the connection-scoped row drops with an exact count.
+    fresh_runtime()
+    register_sim_types()
+    ctl2, _server2, channels2 = make_world()
+    boot_replay("", path)
+    plane2 = ctl2.queryplane
+    assert set(plane2._entries) == {key}
+    assert plane2._entries[key]["name"] == "watch"
+    assert plane2.ledgers["replay_dropped"] == 1
+    run_ticks(ctl2, channels2, 2)
+    assert plane2.sensor_cells(key)
+
+
+def test_snapshot_rows_roundtrip_and_adoption():
+    from channeld_tpu.core.snapshot import take_snapshot, extras_from
+    from channeld_tpu.ops.spatial_ops import AOI_SPHERE
+    from channeld_tpu.spatial.queryplane import restore_registrations
+
+    ctl, _server, _channels = make_world()
+    key = ctl.register_sensor("census", center=(350.0, 50.0),
+                              extent=(60.0, 0.0))
+    client = make_client()
+    assert ctl.queryplane.register_client(
+        client, AOI_SPHERE, (150.0, 50.0), (90.0, 0.0))
+
+    snap = take_snapshot()
+    assert {sq.key for sq in snap.standingQueries} == {key, client.id}
+    extras = extras_from(snap)
+    assert set(extras["queries"]) == {key, client.id}
+
+    # Adoption path (federation/control.py step 5 hands the replica's
+    # rows to the same hook): sensors restore, conn rows drop.
+    fresh_runtime()
+    register_sim_types()
+    ctl2, _server2, channels2 = make_world()
+    restored, dropped = restore_registrations(
+        sorted(extras["queries"].values()), source="adoption")
+    assert (restored, dropped) == (1, 1)
+    plane2 = ctl2.queryplane
+    assert set(plane2._entries) == {key}
+    run_ticks(ctl2, channels2, 2)
+    assert plane2.sensor_cells(key)
+
+
+# ---------------------------------------------------------------------------
+# handler hardening
+# ---------------------------------------------------------------------------
+
+
+def test_malformed_queries_rejected_before_any_table():
+    from channeld_tpu.spatial.messages import handle_update_spatial_interest
+
+    ctl, _server, _channels = make_world()
+    client = make_client()
+
+    def send(build):
+        msg = spatial_pb2.UpdateSpatialInterestMessage(connId=client.id)
+        build(msg.query)
+        ctx = MessageContext(
+            msg_type=MessageType.UPDATE_SPATIAL_INTEREST, msg=msg,
+            connection=client,
+        )
+        handle_update_spatial_interest(ctx)
+
+    def count(field):
+        return metrics.query_malformed.labels(field=field)._value.get()
+
+    def nan_sphere(q):
+        q.sphereAOI.center.x = float("nan")
+        q.sphereAOI.radius = 10.0
+
+    def neg_radius(q):
+        q.sphereAOI.center.x = 50.0
+        q.sphereAOI.radius = -1.0
+
+    def inf_box(q):
+        q.boxAOI.center.x = float("inf")
+        q.boxAOI.extent.x = 10.0
+        q.boxAOI.extent.z = 10.0
+
+    def neg_angle(q):
+        q.coneAOI.center.x = 50.0
+        q.coneAOI.radius = 10.0
+        q.coneAOI.angle = -0.5
+
+    def oversize_spots(q):
+        for i in range(global_settings.queryplane_max_spots + 1):
+            s = q.spotsAOI.spots.add()
+            s.x, s.y, s.z = float(i), 0.0, 0.0
+
+    for build, field in (
+        (nan_sphere, "sphere_not_finite"),
+        (neg_radius, "sphere_radius_negative"),
+        (inf_box, "box_not_finite"),
+        (neg_angle, "cone_angle_negative"),
+        (oversize_spots, "spots_oversize"),
+    ):
+        before = count(field)
+        send(build)
+        assert count(field) == before + 1, field
+
+    # Nothing touched either backend: no standing row, no subs.
+    assert ctl.queryplane.count() == 0
+    assert client.spatial_subscriptions == {}
+
+    # A well-formed query still lands a standing row (the gate rejects
+    # malformed fields, not clients).
+    def good(q):
+        q.sphereAOI.center.x = 150.0
+        q.sphereAOI.center.z = 50.0
+        q.sphereAOI.radius = 90.0
+
+    send(good)
+    assert ctl.queryplane.count() == 1
+    for ch in _channels:  # land the host answer's queued subs
+        ch.tick_once(0)
+    assert client.spatial_subscriptions

@@ -278,3 +278,56 @@ def test_cell_serving_overflow_reoffers_next_tick():
                                      subs, 153)
     assert int(np.asarray(out2["undelivered"]).sum()) == 0
     assert int(np.asarray(out2["cell_counts"]).sum()) == n
+
+
+def test_query_diff_rows_match_dense_from_sharded_interest():
+    """The standing-query changed-rows protocol over the cell-sharded
+    plane: piping the serving step's [Q,C] interest/dist through
+    diff_query_masks yields exactly the dense step's row set (the blob
+    is order-free — compare as sets), and a second diff against the
+    committed baseline with unchanged masks is empty."""
+    from channeld_tpu.ops.spatial_ops import (
+        diff_query_masks,
+        parse_query_blob,
+        spatial_step,
+    )
+    from channeld_tpu.parallel.spatial_alltoall import (
+        build_cell_serving_step,
+        cell_serving_spatial_step,
+    )
+
+    grid = GridSpec(offset_x=0.0, offset_z=0.0, cell_w=100.0, cell_h=100.0,
+                    cols=6, rows=4)
+    mesh = make_space_mesh()
+    pos, prev, valid, queries, subs = _serving_world()
+    dense = spatial_step(grid, pos, prev.copy(), valid, queries, subs, 64,
+                         jnp.int32(120))
+    step = build_cell_serving_step(grid, mesh, bucket=8,
+                                   max_handovers_per_shard=8)
+    out = cell_serving_spatial_step(step, pos, prev.copy(), valid, queries,
+                                    subs, 120)
+
+    q, c = np.asarray(dense["interest"]).shape
+    zero_i = jnp.zeros((q, c), bool)
+    zero_d = jnp.zeros((q, c), jnp.int32)
+
+    def rows_of(interest, dist):
+        blob, next_i, next_d = diff_query_masks(
+            zero_i, zero_d, jnp.asarray(interest), jnp.asarray(dist), 4096)
+        count, rows = parse_query_blob(np.asarray(blob))
+        return (count, {tuple(r) for r in rows[:count].tolist()},
+                next_i, next_d)
+
+    n_dense, dense_rows, base_i, base_d = rows_of(dense["interest"],
+                                                  dense["dist"])
+    n_shard, shard_rows, _, _ = rows_of(out["interest"], out["dist"])
+    assert n_dense == n_shard
+    assert dense_rows == shard_rows
+    assert n_dense == int(np.asarray(dense["interest"]).sum())
+
+    # Committed baseline: nothing moved, nothing emits.
+    blob2, _, _ = diff_query_masks(base_i, base_d,
+                                   jnp.asarray(dense["interest"]),
+                                   jnp.asarray(dense["dist"]), 4096)
+    count2, _ = parse_query_blob(np.asarray(blob2))
+    assert count2 == 0
