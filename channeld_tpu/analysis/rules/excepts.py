@@ -31,6 +31,14 @@ SCOPE: tuple[tuple[str, str], ...] = (
     ("channeld_tpu/spatial/queryplane.py",
      r"^(pump|_consume|_apply_pending|reap_closed|deregister|_install|"
      r"_journal|restore_rows)$"),
+    # Simulation plane (doc/simulation.md): cadence/census hooks run
+    # inside the GLOBAL tick with double-entry ledgers — a swallowed
+    # failure desynchronizes ledger from metric and the sim soak's
+    # exactness assertion lies.
+    ("channeld_tpu/sim/plane.py",
+     r"^(pre_step|on_result|activate|on_agents_adopted|"
+     r"on_agents_departed)$"),
+    ("channeld_tpu/sim/authority.py", r"^(pump|commit)$"),
     ("channeld_tpu/spatial/grid.py", r"^_orchestrate"),
     ("channeld_tpu/spatial/controller.py", r"^tick$"),
     ("channeld_tpu/core/channel.py",

@@ -37,6 +37,15 @@ HOT_PATHS: tuple[tuple[str, str], ...] = (
     ("channeld_tpu/spatial/queryplane.py",
      r"^(pump|_consume|_apply_pending|reap_closed|deregister|_install|"
      r"sensor_cells)$"),
+    # Simulation plane (doc/simulation.md): the agent step is
+    # device->device inside the guarded tick; the plane's ONLY readback
+    # is the census-cadence batched fetch (reasoned disable in
+    # on_result / the guard's prefetch) — everything else on its tick
+    # path must stay transfer-free.
+    ("channeld_tpu/sim/plane.py",
+     r"^(pre_step|on_result|_micro_cells|_on_danger_cells|"
+     r"on_geometry)$"),
+    ("channeld_tpu/sim/authority.py", r"^(pump|commit|_attach)$"),
     # The supervised step wraps the per-tick device readbacks; its ONE
     # designed batched fetch (worker-thread _step_body) carries reasoned
     # disables, everything else in the guard must stay transfer-free.

@@ -59,6 +59,7 @@ SCAN_GLOBS = (
     "channeld_tpu/spatial/*.py",
     "channeld_tpu/ops/*.py",
     "channeld_tpu/chaos/*.py",
+    "channeld_tpu/sim/*.py",
 )
 
 # Handoff mechanisms a ``# tpulint: shared=<mechanism>`` declaration may
@@ -99,6 +100,14 @@ DOMAINS: tuple[Domain, ...] = (
             # module-singleton call the propagator can resolve.
             ("channeld_tpu/spatial/queryplane.py",
              r"^QueryPlane\.(pump|reap_closed)$"),
+            # Simulation plane (doc/simulation.md): cadence/absorb
+            # hooks run inside the controller tick; seeded explicitly
+            # for the same attribute-hop reason (self.simplane.pre_step
+            # / on_result are plain instance fields).
+            ("channeld_tpu/sim/plane.py",
+             r"^SimPlane\.(pre_step|on_result|activate)$"),
+            ("channeld_tpu/sim/authority.py",
+             r"^SimAuthority\.(pump|commit|adopt)$"),
             ("channeld_tpu/spatial/grid.py",
              r"^StaticGrid2DSpatialController\.tick$"),
             ("channeld_tpu/core/connection.py", r"^Connection\.on_bytes$"),

@@ -49,6 +49,13 @@ POINTS = {
     "device.step_hang": "stall INSIDE the guarded step past the watchdog deadline",
     "device.nan": "corrupt device state (NaN positions + garbage cell baselines)",
     "device.rebuild_fail": "fail the in-process engine rebuild attempt",
+    # simulation plane (channeld_tpu/sim/plane.py)
+    "sim.step_nan": "rot the agent rows on device (NaN kinematics + "
+                    "garbage cell baselines; the sentinel-triggered "
+                    "rebuild must heal the population exactly)",
+    "sim.stampede": "herd every agent toward one cell (deterministic "
+                    "handover/density burst: exercises partition "
+                    "splits and overload shedding from the sim plane)",
     # federation trunk plane (federation/trunk.py)
     "trunk.egress_drop": "drop an outbound trunk frame (lossy inter-gateway link)",
     "trunk.sever": "abort the trunk socket before the write (link partition)",

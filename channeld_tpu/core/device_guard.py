@@ -327,6 +327,11 @@ class DeviceGuard:
         result["due_packed"] = np.asarray(result["due_packed"])  # tpulint: disable=hot-readback -- rides the same designed per-tick fetch as the rows above
         if result.get("query_blob") is not None:
             result["query_blob"] = np.asarray(result["query_blob"])  # tpulint: disable=hot-readback -- the standing-query plane's ONE changed-rows transfer, pre-fetched inside the guarded window (doc/query_engine.md)
+        if result.get("sim_census") is not None:
+            result["sim_census"] = tuple(
+                np.asarray(a)  # tpulint: disable=hot-readback -- the sim plane's census-cadence batched fetch (its ONLY readback, doc/simulation.md), pre-fetched inside the guarded window; NOT per-tick
+                for a in result["sim_census"]
+            )
         return result
 
     # ---- corruption sentinel ---------------------------------------------

@@ -453,6 +453,9 @@ overload_sheds = Counter(
     "payloads to already-subscribed dst clients skipped; "
     "handover_defer: crossings re-offered next tick; "
     "follow_interest_defer: follower-interest passes skipped; "
+    "sim_cadence_defer: sim passes skipped at L2+ — the agent "
+    "population halves its cadence before human traffic degrades "
+    "(counted in agents held still); "
     "admission_connection / admission_subscription: L3 refusals with a "
     "ServerBusyMessage; admission_accept: raw CLIENT accepts refused at "
     "the socket past the unauthenticated-backlog headroom. The python "
@@ -575,6 +578,50 @@ query_malformed = Counter(
     "query table (field: which validation tripped — hostile NaN/inf "
     "centers, negative radius/angle, oversize spot lists)",
     ["field"],
+    registry=registry,
+)
+
+# Simulation plane (channeld_tpu/sim; doc/simulation.md). Every
+# counter below is double-entry: the python ledger on the plane
+# (SimPlane.ledgers) or engine (sim_rebuild_counts) must match exactly
+# — the sim soak/bench invariant gates compare the two.
+sim_agents_num = Gauge(
+    "sim_agents_num",
+    "Simulated agents currently registered in the engine's entity "
+    "arrays (they ARE ordinary entities; this gauge is the sim-plane "
+    "slice of entity_num)",
+    registry=registry,
+)
+sim_ticks = Counter(
+    "sim_ticks_total",
+    "Sim passes actually stepped on device (cadence skips and overload "
+    "deferrals don't count; the counter-based RNG cursor advances "
+    "exactly once per increment, which is the replayability contract)",
+    registry=registry,
+)
+sim_census_transfers = Counter(
+    "sim_census_transfers_total",
+    "Census batches fetched device->host — by design the sim plane's "
+    "ONLY device readback, at census cadence, never per tick (the "
+    "bench gate demands zero additional per-tick transfers vs a "
+    "no-sim tick; same contract as query_plane_transfers_total)",
+    registry=registry,
+)
+sim_device_rebuilds = Counter(
+    "sim_device_rebuilds",
+    "Verifications of the rebuilt agent kinematic arrays against the "
+    "host shadow (result=verified: bit-identical; mismatch: divergence "
+    "found). Fires on every verify_device_state over a live sim plane "
+    "— device-guard recovery and geometry-epoch rebuilds both land "
+    "here. The engine ledger (sim_rebuild_counts) must match exactly",
+    ["result"],
+    registry=registry,
+)
+sim_pass_ms = Histogram(
+    "sim_pass_ms",
+    "Host cost of one sim-plane pass (census absorb + authority "
+    "commit when due; ~0 on non-census ticks), milliseconds",
+    buckets=(0.05, 0.1, 0.5, 1.0, 2.0, 5.0, 10.0, 33.0, 100.0),
     registry=registry,
 )
 

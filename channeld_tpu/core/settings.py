@@ -328,6 +328,55 @@ class GlobalSettings:
     # UpdateSpatialInterest message is rejected as malformed.
     queryplane_max_spots: int = 256
 
+    # Simulation plane (new — doc/simulation.md). OFF by default: when
+    # enabled the gateway hosts a server-driven agent population
+    # stepped ON DEVICE inside the guarded spatial tick — agents occupy
+    # ordinary entity slots, so crossings, handover, partitioning,
+    # standing queries and fan-out see them exactly like humans, with
+    # zero extra device<->host transfers per tick.
+    sim_enabled: bool = False
+    # Population spawned at controller load (ignored when a WAL-replayed
+    # census restores the exact prior population instead).
+    sim_agents: int = 1000
+    # Counter-based RNG seed: same seed + same tick count = the same
+    # trajectories, bit-exact (the replayability contract).
+    sim_seed: int = 1
+    # Sim passes per spatial tick denominator: step every Nth tick
+    # (1 = every tick). The overload ladder's L2 additionally halves
+    # this cadence (skips every other scheduled pass) before human
+    # traffic degrades.
+    sim_step_every_ticks: int = 1
+    # Census cadence: every Nth SIM pass the kinematic columns are
+    # fetched (the plane's only readback), folded into the host shadow,
+    # journaled to the WAL, and committed through the authority path.
+    sim_census_every_ticks: int = 50
+    # World-time integration step per sim pass, seconds, and the
+    # kinematic envelope (units/s, units/s^2).
+    sim_step_dt: float = 0.05
+    sim_max_speed: float = 6.0
+    sim_accel: float = 24.0
+    # Steering weights: separation pushes agents out of cells more
+    # crowded than sim_crowd occupants; cohesion pulls strays toward
+    # their cell's centroid.
+    sim_separation: float = 0.6
+    sim_cohesion: float = 0.15
+    sim_crowd: int = 32
+    # Waypoint arrival radius (world units) and the per-tick FSM dice:
+    # idle->wander, wander->seek, wander->idle probabilities.
+    sim_arrive_radius: float = 1.5
+    sim_p_wander: float = 0.2
+    sim_p_seek: float = 0.1
+    sim_p_idle: float = 0.05
+    # Cap on CHANNEL-BACKED agents: up to this many agents get real
+    # entity channels owned by the internal authority connection (full
+    # handover/fan-out semantics). Agents beyond the cap are engine-only
+    # (device-tracked, no channel data — crossings need no
+    # orchestration); intended for engine-direct benches at 100K+.
+    sim_channel_agents: int = 4096
+    # Channel attachments performed per tick while the world boots (the
+    # authority retries cells whose channels don't exist yet).
+    sim_attach_per_tick: int = 256
+
     # Cross-gateway federation plane (new — doc/federation.md). Empty
     # config path = the plane stays disarmed and every hook is a cheap
     # no-op (the gateway is a self-contained world, the pre-federation
@@ -644,6 +693,26 @@ class GlobalSettings:
                        default=self.queryplane_max_spots,
                        help="max spots per client spots query; larger "
                             "lists are rejected as malformed")
+        p.add_argument("-sim",
+                       type=lambda s: s.lower() not in
+                       ("false", "0", "no", "off"),
+                       default=self.sim_enabled,
+                       help="device simulation plane: a server-driven "
+                            "agent population stepped on device inside "
+                            "the guarded spatial tick "
+                            "(doc/simulation.md); agents are ordinary "
+                            "entities to every other plane")
+        p.add_argument("-sim-agents", type=int, default=self.sim_agents,
+                       help="population spawned at controller load "
+                            "(a WAL-replayed census wins over this)")
+        p.add_argument("-sim-seed", type=int, default=self.sim_seed,
+                       help="counter-based RNG seed: same seed + tick "
+                            "count = bit-exact trajectories")
+        p.add_argument("-sim-census", type=int,
+                       default=self.sim_census_every_ticks,
+                       help="census cadence in sim passes: the plane's "
+                            "only device readback, folded to the host "
+                            "shadow + WAL + authority path")
         p.add_argument("-fed", type=str, default="",
                        help="federation config JSON path (shard directory "
                             "+ trunk addresses, doc/federation.md); empty "
@@ -811,6 +880,10 @@ class GlobalSettings:
         self.queryplane_enabled = args.queryplane
         self.queryplane_rows_max = args.queryplane_rows
         self.queryplane_max_spots = args.queryplane_max_spots
+        self.sim_enabled = args.sim
+        self.sim_agents = args.sim_agents
+        self.sim_seed = args.sim_seed
+        self.sim_census_every_ticks = args.sim_census
         self.federation_config = args.fed
         self.federation_gateway_id = args.fed_id
         self.global_control_enabled = args.global_control

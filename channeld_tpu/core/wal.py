@@ -72,6 +72,8 @@ import time
 import zlib
 from typing import Optional
 
+import numpy as np
+
 from ..chaos.injector import chaos as _chaos
 from ..protocol import wal_pb2
 from ..utils.anyutil import pack_any, unpack_any
@@ -364,6 +366,27 @@ class WriteAheadLog:
             geometryEpoch=epoch, splitCells=sorted(splits),
         ))
 
+    def log_sim_census(self, sim_tick: int, seed: int, ids, pos, vel,
+                       state, target) -> None:
+        """One agent census from the sim plane (channeld_tpu/sim,
+        doc/simulation.md): the population's exact kinematic state at
+        ``sim_tick``, packed as x,y,z triples parallel to ``ids``. Last
+        record wins at replay — seed + tick + census restore the exact
+        population and the counter-based RNG resumes the identical
+        trajectory (0 lost/duped across a kill -9).
+
+        All array inputs are HOST numpy already (the census arrives
+        prefetched; the plane slices before calling) — the ravel/tolist
+        below reshape host memory, they transfer nothing."""
+        self.append("sim_census", wal_pb2.WalRecord(
+            simTick=sim_tick, simSeed=seed & 0xFFFFFFFF,
+            simAgentIds=np.asarray(ids, np.uint32).tolist(),  # tpulint: disable=hot-readback -- host numpy in (see docstring); shaping, not a transfer
+            simAgentPos=np.asarray(pos, np.float32).ravel().tolist(),  # tpulint: disable=hot-readback -- host numpy in (see docstring); shaping, not a transfer
+            simAgentVel=np.asarray(vel, np.float32).ravel().tolist(),  # tpulint: disable=hot-readback -- host numpy in (see docstring); shaping, not a transfer
+            simAgentState=np.asarray(state, np.int32).tolist(),  # tpulint: disable=hot-readback -- host numpy in (see docstring); shaping, not a transfer
+            simAgentTarget=np.asarray(target, np.float32).ravel().tolist(),  # tpulint: disable=hot-readback -- host numpy in (see docstring); shaping, not a transfer
+        ))
+
     def log_blacklist(self, kind: str, key: str) -> None:
         self.append("blacklist", wal_pb2.WalRecord(
             blacklistKind=kind, blacklistKey=key,
@@ -605,6 +628,7 @@ def boot_replay(snapshot_path: str, wal_path: str) -> dict:
     )
     # key -> (key, scope, name, kind, params, spot_dists); last wins.
     queries: dict[int, tuple] = dict(extras["queries"]) if extras else {}
+    sim_census = None  # last sim_census record wins
     flips: dict[int, int] = {}
     for r in records:
         k = r.kind
@@ -656,6 +680,8 @@ def boot_replay(snapshot_path: str, wal_path: str) -> dict:
                 banned_pits.add(r.blacklistKey)
         elif k == "geometry":
             geometry_state = (r.geometryEpoch, frozenset(r.splitCells))
+        elif k == "sim_census":
+            sim_census = r  # last census wins; applied below
         elif k == "query":
             if r.op == "remove":
                 queries.pop(r.queryKey, None)
@@ -763,6 +789,17 @@ def boot_replay(snapshot_path: str, wal_path: str) -> dict:
             wal._count_replayed("query", n_restored)
         if n_dropped:
             wal._count_replayed("query_dropped", n_dropped)
+    if sim_census is not None:
+        # Sim plane (channeld_tpu/sim): stash the census for the plane
+        # to consume when it activates (controller load order puts the
+        # plane after boot replay). Seed + tick + census restore the
+        # exact population — the counter-based RNG resumes the
+        # identical trajectory.
+        from ..sim.plane import restore_census
+
+        n_agents = restore_census(sim_census, source="wal replay")
+        if n_agents:
+            wal._count_replayed("sim_census", n_agents)
     from ..federation.directory import directory
 
     version, overrides = directory_state

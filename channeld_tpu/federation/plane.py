@@ -451,6 +451,13 @@ class FederationPlane:
         moved_hook = getattr(ctl, "_note_entity_data_moved", None)
         if moved_hook is not None and flips:
             moved_hook(flips, batch.dst_channel_id)
+        simplane = getattr(ctl, "simplane", None)
+        if simplane is not None:
+            # Sim agents ride shard migration like any entity: the
+            # remove_channel below untracks them (the agent flag clears
+            # with the slot); the plane keeps its census accounting
+            # exact (doc/simulation.md).
+            simplane.on_agents_departed(batch.entities)
         redirected = []
         for eid in batch.entities:
             # The entity now lives on the peer: its local channel (and
@@ -718,6 +725,12 @@ class FederationPlane:
             moved_hook = getattr(ctl, "_note_entity_data_moved", None)
             if moved_hook is not None:
                 moved_hook(list(adopted), msg.dstChannelId)
+            simplane = getattr(ctl, "simplane", None)
+            if simplane is not None:
+                # Ids in the reserved agent range rejoin THIS gateway's
+                # simulated population (doc/simulation.md): re-flagged
+                # as agents, channel-backed by the adoption above.
+                simplane.on_agents_adopted(list(adopted))
 
         self._dst_fanout(dst_ch, msg.srcChannelId, msg.dstChannelId, adopted)
         self._applied[(peer, msg.batchId)] = (msg.dstChannelId,

@@ -27,10 +27,14 @@ from .spatial_ops import (
     AOI_NONE,
     AOI_SPHERE,
     AOI_SPOTS,
+    SIM_IDLE,
+    SIM_SEEK,
     GridSpec,
     QuerySet,
+    SimParams,
     diff_query_masks,
     parse_query_blob,
+    sim_step,
     spatial_step,
 )
 
@@ -183,6 +187,48 @@ class SpatialEngine:
         self._d_queries: Optional[QuerySet] = None  # tpulint: shared=fence
         self._d_sub_state = None  # tpulint: shared=fence
 
+        # Simulation plane (channeld_tpu/sim, doc/simulation.md): agents
+        # occupy ORDINARY entity slots — the sim pass advances their
+        # positions in the same device arrays every downstream plane
+        # reads (crossings, AOI, fan-out, standing queries), so NPCs are
+        # indistinguishable from humans past this point and cost zero
+        # extra transfers. The kinematic columns (velocity, FSM state,
+        # waypoint) follow the positions staging discipline: host
+        # shadows + dirty-slot scatters, full re-upload when the device
+        # copy is dropped. The device is authoritative for agent rows
+        # between censuses; the host shadow refreshes only at census
+        # boundaries (absorb_census), which is why a rebuild reproduces
+        # the last census exactly — the replay contract doc/simulation.md
+        # pins.
+        self.sim_enabled = False  # tpulint: shared=fence
+        self.sim_seed = 0
+        self.sim_params: Optional[SimParams] = None
+        self.sim_tick = 0  # counter-based RNG cursor  # tpulint: shared=fence
+        # Per-tick scheduling flags, staged by the controller on the
+        # tick loop before the step is dispatched (same handoff as the
+        # dirty staging sets: the loop blocks on the worker, and a
+        # zombie worker's commit is generation-fenced).
+        self.run_sim_pass = False  # tpulint: shared=fence
+        self.sim_census_due = False  # tpulint: shared=fence
+        self._agent_mask = np.zeros(entity_capacity, bool)
+        self._vel = np.zeros((entity_capacity, 3), np.float32)
+        self._sim_state = np.zeros(entity_capacity, np.int32)
+        self._sim_target = np.zeros((entity_capacity, 3), np.float32)
+        self._sim_dirty: set[int] = set()  # tpulint: shared=fence
+        # Danger mask (bool[num_cells]) rasterized by the sim plane from
+        # query-plane sensor hits; uploaded only when a sensor's
+        # interest set changes — never per tick.
+        self._flee_cells: Optional[np.ndarray] = None
+        self._flee_dirty = False  # tpulint: shared=fence
+        self._d_agent = None  # tpulint: shared=fence
+        self._d_vel = None  # tpulint: shared=fence
+        self._d_sim_state = None  # tpulint: shared=fence
+        self._d_sim_target = None  # tpulint: shared=fence
+        self._d_flee = None  # tpulint: shared=fence
+        # Double-entry ledger mirroring sim_device_rebuilds_total{result}
+        # (scripts/sim_soak.py cross-checks both sides).
+        self.sim_rebuild_counts: dict[str, int] = {}
+
         self._start = time.monotonic()
         self.last_result: Optional[dict] = None  # tpulint: shared=fence
         # Abandoned-step fence (core/device_guard.py): the watchdog bumps
@@ -243,6 +289,11 @@ class SpatialEngine:
             return
         self._valid[slot] = False
         self._dirty_slots.add(slot)
+        if self._agent_mask[slot]:
+            # A departed agent's slot must stop stepping immediately —
+            # a reused slot would otherwise inherit the sim pass.
+            self._agent_mask[slot] = False
+            self._sim_dirty.add(slot)
         self._free.append(slot)
 
     def entity_count(self) -> int:
@@ -380,6 +431,130 @@ class SpatialEngine:
         self._sub_last[s] = now_ms
         self._sub_last_dirty.add(s)
 
+    # ---- simulation plane (channeld_tpu/sim, doc/simulation.md) ----------
+
+    def seed_agents(self, entries, seed: int, params: SimParams,
+                    vels=None, states=None, targets=None) -> list[int]:
+        """Register a simulated population into ordinary entity slots.
+
+        ``entries`` is [(entity_id, x, y, z)]. ``vels``/``states``/
+        ``targets`` restore a census (WAL replay, federation adoption);
+        a fresh spawn starts IDLE at rest, targeting its own position.
+        Mesh-sharded engines don't run the sim pass (the kernel is
+        single-device; documented in doc/simulation.md). Returns the
+        slots used."""
+        if self._mesh is not None:
+            raise RuntimeError("sim plane requires a single-device engine")
+        slots = []
+        for i, (eid, x, y, z) in enumerate(entries):
+            slot = self.add_entity(eid, float(x), float(y), float(z))
+            self._agent_mask[slot] = True
+            self._vel[slot] = vels[i] if vels is not None else (0.0, 0.0, 0.0)
+            self._sim_state[slot] = (
+                int(states[i]) if states is not None else SIM_IDLE
+            )
+            self._sim_target[slot] = (
+                targets[i] if targets is not None else (x, y, z)
+            )
+            self._sim_dirty.add(slot)
+            slots.append(slot)
+        self.sim_seed = int(seed) & 0xFFFFFFFF
+        self.sim_params = params
+        self.sim_enabled = True
+        return slots
+
+    def agent_slots(self) -> np.ndarray:
+        """Live agent slot indices, ascending (host-shadow truth)."""
+        return np.nonzero(self._agent_mask & self._valid)[0]
+
+    def agent_count(self) -> int:
+        return int(np.count_nonzero(self._agent_mask & self._valid))
+
+    def agent_ids(self, slots: Optional[np.ndarray] = None) -> np.ndarray:
+        """Entity ids for ``slots`` (default: all live agent slots)."""
+        if slots is None:
+            slots = self.agent_slots()
+        return self._entity_of_slot[slots]
+
+    def is_agent(self, entity_id: int) -> bool:
+        slot = self._slot_of_entity.get(entity_id)
+        return slot is not None and bool(self._agent_mask[slot])
+
+    def absorb_census(self, slots: np.ndarray, positions, vel, state,
+                      target) -> None:
+        """Fold a fetched census (full-capacity device arrays, already
+        numpy) back into the host shadows WITHOUT marking anything dirty
+        — the values came FROM the device, so re-uploading them would be
+        pure waste and re-staging them could clobber a newer device
+        tick. After this call the host shadow is bit-identical to the
+        device for every agent row, which is what makes the next
+        rebuild/verify exact."""
+        self._positions[slots] = positions[slots]
+        self._vel[slots] = vel[slots]
+        self._sim_state[slots] = state[slots]
+        self._sim_target[slots] = target[slots]
+
+    def set_flee_cells(self, cells) -> None:
+        """Install the danger mask driving FLEE: an iterable of micro
+        cell indices (query-plane sensor hits, rasterized by the sim
+        plane). Uploaded on the next flush — only when this is called,
+        never per tick."""
+        mask = np.zeros(self.grid.num_cells, bool)
+        for c in cells:
+            if 0 <= c < self.grid.num_cells:
+                mask[c] = True
+        self._flee_cells = mask
+        self._flee_dirty = True
+
+    def sim_stampede(self, cell: int) -> None:
+        """CHAOS ONLY (``sim.stampede``): herd every agent toward one
+        cell — a deterministic handover/density burst that exercises
+        partition splits and overload shedding from the sim plane.
+        Host-staged like any other mutation, so it rides the ordinary
+        fenced scatter into the next tick."""
+        g = self.grid
+        cx = g.offset_x + (cell % g.cols + 0.5) * g.cell_w
+        cz = g.offset_z + (cell // g.cols + 0.5) * g.cell_h
+        slots = self.agent_slots()
+        self._sim_state[slots] = SIM_SEEK
+        self._sim_target[slots, 0] = cx
+        self._sim_target[slots, 2] = cz
+        self._vel[slots] = 0.0
+        self._sim_dirty.update(int(s) for s in slots)
+
+    def corrupt_sim_state_for_chaos(self) -> None:
+        """CHAOS ONLY (``sim.step_nan``): rot the agent rows the way a
+        bad kernel output would — NaN positions/velocities on a quarter
+        of the agents, plus garbage prev-cell baselines on the same rows
+        so the fault carries the impossible-src-cell signature the
+        readback sentinel detects (same detection path as ``device.nan``;
+        the triggered rebuild re-seeds the rotted rows from the host
+        shadow and the population resumes its replayable trajectory)."""
+        live = self.agent_slots()
+        n = max(1, len(live) // 4)
+        rows = live[:n].astype(np.int32)
+        self._d_cell = self._keep_entity_sharding(
+            self._d_cell.at[rows].set(1 << 24)
+        )
+        self._d_positions = self._keep_entity_sharding(
+            self._d_positions.at[rows].set(float("nan"))
+        )
+        if self._d_vel is not None:
+            self._d_vel = self._keep_entity_sharding(
+                self._d_vel.at[rows].set(float("nan"))
+            )
+
+    def _count_sim_rebuild(self, result: str) -> None:
+        """Double-entry sim rebuild accounting: python ledger AND
+        prometheus move together on every verification of the agent
+        arrays (scripts/sim_soak.py asserts both sides agree)."""
+        self.sim_rebuild_counts[result] = (
+            self.sim_rebuild_counts.get(result, 0) + 1
+        )
+        from ..core import metrics
+
+        metrics.sim_device_rebuilds.labels(result=result).inc()
+
     # ---- the tick --------------------------------------------------------
 
     def _keep_entity_sharding(self, arr):
@@ -423,6 +598,43 @@ class SpatialEngine:
             _fence()
             self._d_cell = d_cell
             self._seed_cells.clear()
+        if self.sim_enabled:
+            if self._d_vel is None:
+                # First upload (or post-rebuild re-upload) of the whole
+                # kinematic column set. .copy(): async H2D vs later host
+                # writes, same contract as every other mirror.
+                d_vel = jnp.asarray(self._vel.copy())
+                d_state = jnp.asarray(self._sim_state.copy())
+                d_target = jnp.asarray(self._sim_target.copy())
+                d_agent = jnp.asarray(self._agent_mask.copy())
+                _fence()
+                self._d_vel = d_vel
+                self._d_sim_state = d_state
+                self._d_sim_target = d_target
+                self._d_agent = d_agent
+                self._sim_dirty.clear()
+            elif self._sim_dirty:
+                idx = np.fromiter(self._sim_dirty, np.int32,
+                                  len(self._sim_dirty))
+                d_vel = self._d_vel.at[idx].set(self._vel[idx])
+                d_state = self._d_sim_state.at[idx].set(self._sim_state[idx])
+                d_target = self._d_sim_target.at[idx].set(
+                    self._sim_target[idx]
+                )
+                d_agent = self._d_agent.at[idx].set(self._agent_mask[idx])
+                _fence()
+                self._d_vel = d_vel
+                self._d_sim_state = d_state
+                self._d_sim_target = d_target
+                self._d_agent = d_agent
+                self._sim_dirty.clear()
+            if self._flee_cells is not None and (
+                self._d_flee is None or self._flee_dirty
+            ):
+                d_flee = jnp.asarray(self._flee_cells.copy())
+                _fence()
+                self._d_flee = d_flee
+                self._flee_dirty = False
         spots_changed = False
         if self._q_spot_dist is not None:
             if self._d_spot_dist is None:
@@ -507,6 +719,31 @@ class SpatialEngine:
         self.tick(now_ms=0)
         self.last_result = None
 
+    def sim_warmup(self) -> None:
+        """Compile the sim step at plane activation, for the same reason
+        ``warmup`` exists: the first live sim tick must not pay XLA
+        compilation inside the guarded window (a multi-second stall
+        there reads as a hang and trips the watchdog). Runs on
+        throwaway arrays of the live shapes — sim_step donates its
+        inputs, so the live arrays are never handed to a warmup."""
+        if self.sim_params is None:
+            return
+        n = self.entity_capacity
+        jax.block_until_ready(
+            sim_step(
+                self.grid,
+                jnp.zeros((n, 3), jnp.float32),
+                jnp.zeros((n, 3), jnp.float32),
+                jnp.zeros(n, jnp.int32),
+                jnp.zeros((n, 3), jnp.float32),
+                jnp.zeros(n, bool),
+                jnp.zeros(self.grid.num_cells, bool),
+                self.sim_params,
+                jnp.uint32(self.sim_seed),
+                jnp.int32(0),
+            )
+        )
+
     def tick(self, now_ms: Optional[int] = None) -> dict:
         """Run one device decision pass; returns numpy-backed results."""
         if now_ms is None:
@@ -516,12 +753,39 @@ class SpatialEngine:
         # other place a watchdog-abandoned worker could write stale
         # arrays over a rebuilt engine (see _flush_host_state).
         self._flush_host_state(expect_generation=gen)
+        # Sim pass first (device->device): agents advance, then the
+        # spatial pass reads the SAME position array — crossings, AOI,
+        # standing queries and fan-out all see the moved agents this
+        # very tick, with zero extra transfers. The committed flags were
+        # staged by the controller on the loop thread before dispatch.
+        sim_committed = None
+        census_due = False
+        positions = self._d_positions
+        if (self.sim_enabled and self.run_sim_pass and self._mesh is None
+                and self._d_vel is not None):
+            flee = self._d_flee
+            if flee is None:
+                flee = jnp.zeros(self.grid.num_cells, bool)
+            sim_committed = sim_step(
+                self.grid,
+                positions,
+                self._d_vel,
+                self._d_sim_state,
+                self._d_sim_target,
+                self._d_agent,
+                flee,
+                self.sim_params,
+                jnp.uint32(self.sim_seed),
+                jnp.int32(self.sim_tick),
+            )
+            positions = sim_committed[0]
+            census_due = self.sim_census_due
         if self._mesh is not None:
             out = self._mesh_tick(now_ms)
         else:
             out = spatial_step(
                 self.grid,
-                self._d_positions,
+                positions,
                 self._d_cell,
                 self._d_valid,
                 self._d_queries,
@@ -564,6 +828,25 @@ class SpatialEngine:
             raise RuntimeError("stale device tick abandoned by watchdog")
         # Baseline for the next tick: crossings that overflowed the handover
         # row budget keep their old cell so they are re-detected, not lost.
+        if sim_committed is not None:
+            # The sim batch commits ATOMICALLY with the spatial commit
+            # and only past the fence above — a watchdog-abandoned step
+            # can never leave a torn population (positions advanced but
+            # kinematics not, or vice versa); the abandoned tick's
+            # donated buffers die with it and the guard's rebuild
+            # re-uploads every column from the host shadow.
+            (self._d_positions, self._d_vel, self._d_sim_state,
+             self._d_sim_target) = sim_committed
+            self.sim_tick += 1
+            if census_due:
+                # Device handles for the census columns; the guard
+                # pre-fetches them to numpy inside the guarded window
+                # (core/device_guard.py), the sim plane absorbs them.
+                out["sim_census"] = (
+                    self._d_positions, self._d_vel, self._d_sim_state,
+                    self._d_sim_target,
+                )
+                out["sim_tick"] = self.sim_tick
         self._d_cell = out["committed_prev"]
         self._d_sub_state = (
             out["new_last_fanout_ms"],
@@ -784,6 +1067,19 @@ class SpatialEngine:
         self._d_spot_dist = None
         self._spot_dirty_rows.clear()
         self._queries_dirty = True
+        # Sim kinematic columns: the host shadow (last census + explicit
+        # stages) is authoritative; dropping the device copies forces the
+        # whole-column re-upload path on the flush below, which is what
+        # makes the rebuilt arrays bit-identical to the shadow
+        # (verify_device_state proves it, sim_device_rebuilds_total
+        # counts it).
+        self._d_vel = None
+        self._d_sim_state = None
+        self._d_sim_target = None
+        self._d_agent = None
+        self._d_flee = None
+        self._flee_dirty = self._flee_cells is not None
+        self._sim_dirty.clear()
         # Standing-query diff baseline: gone with the rest of the device
         # state. The epoch bump tells the host plane its mirrors no
         # longer connect to the next tick's delta stream — it must
@@ -824,6 +1120,10 @@ class SpatialEngine:
         self._q_spot_dist = None
         self._d_spot_dist = None
         self._spot_dirty_rows.clear()
+        # The flee mask is [num_cells] in cell space: drop it; the sim
+        # plane re-rasterizes its sensors' hits against the new geometry
+        # (its on_geometry hook fires after the swap).
+        self._flee_cells = None
         for conn_id, (spots, dists) in list(self._spot_sources.items()):
             self.set_spots_query(conn_id, spots, dists)
         self.rebuild_device_state(slot_cells, now_ms=now_ms,
@@ -871,6 +1171,27 @@ class SpatialEngine:
                 errors.append("sub active mask differs from host shadow")
             if not np.array_equal(np.asarray(last), self._sub_last):
                 errors.append("sub clock differs from rebuild seed")
+        if self.sim_enabled and self._d_vel is not None:
+            sim_errors: list[str] = []
+            for name, dev, host, has_nan in (
+                ("agent velocities", self._d_vel, self._vel, True),
+                ("agent FSM states", self._d_sim_state, self._sim_state,
+                 False),
+                ("agent waypoints", self._d_sim_target, self._sim_target,
+                 True),
+                ("agent mask", self._d_agent, self._agent_mask, False),
+            ):
+                if not np.array_equal(np.asarray(dev), host,
+                                      equal_nan=has_nan):
+                    sim_errors.append(f"{name} differ from host shadow")
+            if self._flee_cells is not None and self._d_flee is not None:
+                if not np.array_equal(np.asarray(self._d_flee),
+                                      self._flee_cells):
+                    sim_errors.append("flee mask differs from host shadow")
+            errors.extend(sim_errors)
+            self._count_sim_rebuild(
+                "verified" if not sim_errors else "mismatch"
+            )
         return errors
 
     def corrupt_device_state_for_chaos(self) -> None:

@@ -96,6 +96,10 @@ class TPUSpatialController(StaticGrid2DSpatialController):
         # batch-readback path serves follows and client queries stay
         # host-evaluated per message.
         self.queryplane = None
+        # Simulation plane (channeld_tpu/sim; doc/simulation.md): None =
+        # disabled, no agent population, every hook below is one None
+        # check.
+        self.simplane = None
 
     def load_config(self, config: dict) -> None:
         super().load_config(config)
@@ -148,6 +152,17 @@ class TPUSpatialController(StaticGrid2DSpatialController):
             # on-device diff/compaction step.
             self.queryplane = QueryPlane(self, self.engine)
         self.engine.warmup()  # compile before listeners open (see warmup)
+        if global_settings.sim_enabled and mesh is None:
+            # On-device world simulation (channeld_tpu/sim;
+            # doc/simulation.md): spawn/restore the agent population and
+            # pre-compile the sim kernel — after warmup so the spatial
+            # step's compile cost is already paid, still before
+            # listeners open. The sim kernel is single-device; a meshed
+            # engine skips the plane (documented in doc/simulation.md).
+            from ..sim.plane import SimPlane
+
+            self.simplane = SimPlane(self, self.engine)
+            self.simplane.activate()
 
     # ---- decision plane --------------------------------------------------
 
@@ -376,7 +391,12 @@ class TPUSpatialController(StaticGrid2DSpatialController):
         if self.engine is None:
             return
         if (self._mcols, self._mrows) == old:
-            return  # same micro grid; only the leaf mapping moved
+            # Same micro grid; only the leaf mapping moved — but that
+            # remap still invalidates the sim plane's FLEE mask (it is
+            # keyed by micro index via leaf hits).
+            if self.simplane is not None:
+                self.simplane.on_geometry()
+            return
         from ..core import metrics
         from ..ops.spatial_ops import GridSpec
 
@@ -392,6 +412,11 @@ class TPUSpatialController(StaticGrid2DSpatialController):
             ),
             seeds,
         )
+        if self.simplane is not None:
+            # Depth change: the device arrays rebuilt onto the new micro
+            # grid (agent rows re-uploaded from the host shadow by the
+            # same path); re-rasterize the FLEE mask onto it.
+            self.simplane.on_geometry()
         errors = self.engine.verify_device_state(seeds)
         metrics.partition_device_rebuilds.labels(
             result="verified" if not errors else "mismatch"
@@ -687,6 +712,11 @@ class TPUSpatialController(StaticGrid2DSpatialController):
             stall = _chaos.stall_s("device.dispatch_stall")
             if stall:
                 _time.sleep(stall)  # tpulint: disable=async-blocking -- chaos-injected dispatch stall MODELS a busy chip stalling the tick (doc/chaos.md); blocking is the point
+        if self.simplane is not None:
+            # Sim cadence/chaos decisions for THIS tick (sets the
+            # engine's run_sim_pass/sim_census_due flags; the agent step
+            # itself runs inside the guarded device tick below).
+            self.simplane.pre_step()
         if _guard.enabled:
             # Supervised step (doc/device_recovery.md): watchdog +
             # transient retry + sentinel + in-process rebuild. None =
@@ -724,6 +754,10 @@ class TPUSpatialController(StaticGrid2DSpatialController):
                     "this tick (slots %s...), re-offered next tick",
                     overflow, self.engine.undelivered_slots(result)[:8],
                 )
+        if self.simplane is not None:
+            # Census-cadence absorb/journal/commit (a no-op on every
+            # non-census tick beyond one counter diff).
+            self.simplane.on_result(result)
         self._publish_due(result)
         if handovers or self._deferred_crossings:
             # Batched orchestration: one owner-swap/remove-add/fan-out
@@ -739,6 +773,15 @@ class TPUSpatialController(StaticGrid2DSpatialController):
                     # than the channel geometry here (an unsplit neighbor
                     # pins the micro depth); no channel boundary crossed,
                     # nothing to orchestrate.
+                    continue
+                if (self.simplane is not None
+                        and self.engine.is_agent(e)
+                        and not self.simplane.authority.is_backed(e)):
+                    # Engine-only agent (past the sim_channel_agents
+                    # cap, or its cell channel is still booting): no
+                    # channel data lives anywhere, so there is nothing
+                    # to orchestrate — the device cell tracking alone is
+                    # authoritative for it (doc/simulation.md).
                     continue
                 prev = pending.get(e)
                 if prev is not None:
@@ -871,7 +914,22 @@ class TPUSpatialController(StaticGrid2DSpatialController):
                 old_info = None
         if old_info is None:
             old_info = self._cell_center(src_cell)
-        new_info = self._last_positions.get(entity_id) or self._cell_center(dst_cell)
+        # Same consistency rule on the destination side: the host belief
+        # can LAG the device for sim agents (their positions advance on
+        # device every tick but _last_positions only refreshes at census
+        # cadence), and a stale new_info that still maps to src would
+        # collapse the crossing to s == d — dropped forever, since the
+        # device baseline already committed to dst and never re-detects.
+        new_info = self._last_positions.get(entity_id)
+        if new_info is not None:
+            try:
+                mapped = self._micro_index(new_info)
+            except ValueError:
+                mapped = -1
+            if mapped != dst_cell:
+                new_info = None
+        if new_info is None:
+            new_info = self._cell_center(dst_cell)
         return old_info, new_info, provider
 
     def _run_handover(self, entity_id: int, src_cell: int, dst_cell: int) -> None:

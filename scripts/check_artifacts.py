@@ -93,6 +93,21 @@ SCHEMAS: dict[str, set] = {
         "metric", "scale", "crossover", "changed_rows",
         "follower_1k", "ledgers",
     },
+    # On-device simulation bench (doc/simulation.md acceptance
+    # artifact): the 100K-agents-stepped-on-device scale record with
+    # the zero-extra-transfers counter evidence, the steady-tick
+    # overhead, the census exactness proof, and the rebuild
+    # double-entry ledgers.
+    "BENCH_SIM_*.json": {
+        "metric", "agents", "ticks", "steady", "transfers", "census",
+        "ledgers",
+    },
+    # On-device simulation soak (doc/simulation.md acceptance
+    # artifact): exact census (zero agents lost or duplicated) across
+    # the steady / stampede / guard-rebuild / geometry-epoch / kill -9
+    # phases, with the restored population bit-identical to the last
+    # journaled census.
+    "SOAK_SIM_*.json": _SOAK_KEYS | {"phases", "agents", "seed"},
     # Adaptive-partitioning density soak (doc/partitioning.md
     # acceptance artifact): the geometry ledgers, the kill-mid-split
     # record, the steady-state density fold, the final geometry, and
@@ -413,6 +428,98 @@ def _check_query_bench(doc: dict) -> list[str]:
     return errors
 
 
+def _check_sim_bench(doc: dict) -> list[str]:
+    """The sim bench's acceptance bar beyond key presence
+    (doc/simulation.md): >= 100K agents actually stepped on device
+    every tick, ZERO extra device->host fetches on a steady tick —
+    the counted per-tick fetch rate with the sim pass armed must be
+    bit-equal to the no-sim loop's — and the census exact: rebuild
+    verified clean, every agent id preserved, double-entry between the
+    engine rebuild ledger and the sim_device_rebuilds metric."""
+    errors: list[str] = []
+    if doc.get("agents", 0) < 100_000:
+        errors.append(
+            f"fewer than 100K agents at the scale point "
+            f"({doc.get('agents')})"
+        )
+    steady = doc.get("steady", {})
+    ticks = doc.get("ticks")
+    if not ticks or steady.get("sim_ticks_advanced") != ticks:
+        errors.append(
+            f"sim pass did not run every tick (ticks={ticks}, "
+            f"advanced={steady.get('sim_ticks_advanced')})"
+        )
+    tr = doc.get("transfers", {})
+    if tr.get("extra_per_tick") != 0:
+        errors.append(
+            f"steady tick not transfer-free: extra_per_tick="
+            f"{tr.get('extra_per_tick')}"
+        )
+    if tr.get("sim_fetches_per_tick") is None or \
+            tr.get("sim_fetches_per_tick") != tr.get(
+                "no_sim_fetches_per_tick"):
+        errors.append(
+            f"per-tick fetch rate with sim armed does not match the "
+            f"no-sim loop (sim={tr.get('sim_fetches_per_tick')}, "
+            f"no_sim={tr.get('no_sim_fetches_per_tick')})"
+        )
+    census = doc.get("census", {})
+    if census.get("verify_errors") != 0:
+        errors.append(
+            f"post-census rebuild not verified clean "
+            f"(verify_errors={census.get('verify_errors')})"
+        )
+    if not census.get("ids_exact"):
+        errors.append("census did not preserve every agent id")
+    if census.get("agents", 0) < doc.get("agents", 0):
+        errors.append(
+            f"census covered fewer agents than seeded "
+            f"({census.get('agents')} < {doc.get('agents')})"
+        )
+    ledgers = doc.get("ledgers", {})
+    eng = ledgers.get("sim_rebuilds_verified")
+    met = ledgers.get("sim_device_rebuilds_total_verified")
+    if not eng or eng != met:
+        errors.append(
+            f"double-entry sim_rebuilds_verified == "
+            f"sim_device_rebuilds_total_verified not proven "
+            f"(ledgers={ledgers})"
+        )
+    return errors
+
+
+def _check_sim_soak(doc: dict) -> list[str]:
+    """The sim soak's acceptance bar beyond key presence
+    (doc/simulation.md): all five phases ran, the kill -9 phase
+    carries the bit-identical restored-census evidence, and the
+    zero-loss census held at every phase boundary."""
+    errors: list[str] = []
+    phases = doc.get("phases", {})
+    for required in ("steady", "stampede", "guard", "epoch", "kill9"):
+        if required not in phases:
+            errors.append(f"phase {required!r} missing")
+    if not phases.get("kill9", {}).get("restored_hash"):
+        errors.append("kill9 phase has no restored census hash")
+    names = {
+        c.get("name") for c in doc.get("invariants", {}).get("checks", [])
+    }
+    for required in (
+        "kill9: restored census bit-identical to last journaled",
+        "kill9: replay counter double-entry",
+        "steady: census transfer double-entry",
+        "guard: sim rebuild double-entry",
+        "stampede: crossings flowed through ordinary handover",
+    ):
+        if required not in names:
+            errors.append(f"missing invariant check {required!r}")
+    for phase in ("steady", "stampede", "guard", "epoch", "kill9"):
+        for kind in ("lost from", "duplicated in"):
+            check = f"{phase}: zero agents {kind} cell tables"
+            if check not in names:
+                errors.append(f"missing invariant check {check!r}")
+    return errors
+
+
 EXTRA_CHECKS = {
     "SOAK_GLOBAL_*.json": _check_global_soak,
     "SOAK_DEVICE_*.json": _check_device_soak,
@@ -421,6 +528,8 @@ EXTRA_CHECKS = {
     "SOAK_ABUSE_*.json": _check_abuse_soak,
     "SOAK_SPLIT_*.json": _check_density_soak,
     "BENCH_QUERY_*.json": _check_query_bench,
+    "BENCH_SIM_*.json": _check_sim_bench,
+    "SOAK_SIM_*.json": _check_sim_soak,
 }
 
 
@@ -725,10 +834,54 @@ def check_query_engine_doc(repo: str = REPO) -> list[str]:
     return errors
 
 
+def check_simulation_doc(repo: str = REPO) -> list[str]:
+    """doc/simulation.md must document every ``sim_*`` operator knob
+    core/settings.py declares, as a row in its knob table (a knob
+    added without doc — or documented after removal — is drift). The
+    table-row anchor keeps the gate honest: the ``sim_`` prefix is
+    shared by the metric family (`sim_pass_ms`, `sim_agents_num`, ...)
+    so a bare backtick scan cannot distinguish knob from metric. The
+    docs whose planes the population rides must cross-link it: README,
+    doc/device_recovery.md (sim columns in the rebuild + sentinel),
+    doc/query_engine.md (the danger-zone sensor), doc/chaos.md (the
+    ``sim.*`` injection points)."""
+    path = os.path.join(repo, "doc", "simulation.md")
+    if not os.path.exists(path):
+        return ["doc/simulation.md missing (simulation plane operator "
+                "reference)"]
+    text = open(path).read()
+    errors: list[str] = []
+    settings_src = open(
+        os.path.join(repo, "channeld_tpu", "core", "settings.py")
+    ).read()
+    declared = set(re.findall(r"^    (sim_[a-z0-9_]+):",
+                              settings_src, re.M))
+    documented = set(re.findall(r"^\| `(sim_[a-z0-9_]+)` \|",
+                                text, re.M))
+    for name in sorted(declared - documented):
+        errors.append(
+            f"doc/simulation.md: knob {name!r} is declared in "
+            "core/settings.py but missing from the knob table"
+        )
+    for name in sorted(documented - declared):
+        errors.append(
+            f"doc/simulation.md: knob table documents {name!r} with no "
+            "matching declaration in core/settings.py"
+        )
+    for rel in ("README.md", "doc/device_recovery.md",
+                "doc/query_engine.md", "doc/chaos.md"):
+        linked = os.path.join(repo, rel)
+        if not os.path.exists(linked) \
+                or "simulation.md" not in open(linked).read():
+            errors.append(f"{rel}: no cross-link to doc/simulation.md")
+    return errors
+
+
 def main() -> int:
     errors = (check_artifacts() + check_doc_metrics()
               + check_artifact_metrics() + check_concurrency_doc()
-              + check_partitioning_doc() + check_query_engine_doc())
+              + check_partitioning_doc() + check_query_engine_doc()
+              + check_simulation_doc())
     if errors:
         for e in errors:
             print(f"DRIFT: {e}")

@@ -212,6 +212,11 @@ async def run_density_soak(p: DensitySoakParams) -> dict:
     global_settings.trace_enabled = False
     global_settings.device_guard_enabled = False
     global_settings.slo_enabled = False
+    # Simulation plane pinned OFF (doc/simulation.md): an agent
+    # population would add its own crossings/census traffic to this
+    # soak's deterministic accounting; scripts/sim_soak.py is the sim
+    # plane's own soak.
+    global_settings.sim_enabled = False
     from channeld_tpu.core.tracing import recorder as _flight_recorder
 
     _flight_recorder.configure(enabled=False)

@@ -196,6 +196,11 @@ async def run_soak(p: SoakParams) -> dict:
     # assumes the static boot grid (doc/partitioning.md);
     # scripts/density_soak.py is the partitioning plane's own soak.
     global_settings.partition_enabled = False
+    # Simulation plane pinned OFF (doc/simulation.md): an agent
+    # population would add its own crossings/census traffic to this
+    # soak's deterministic accounting; scripts/sim_soak.py is the sim
+    # plane's own soak.
+    global_settings.sim_enabled = False
     global_settings.trace_enabled = False
     # SLO plane pinned OFF (doc/observability.md): this soak's
     # envelope predates the delivery-latency sampling; the health

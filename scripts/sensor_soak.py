@@ -72,6 +72,10 @@ def main() -> int:
     register_sim_types()
     global_settings.tpu_entity_capacity = max(512, args.entities * 2)
     global_settings.tpu_query_capacity = 512
+    # Simulation plane pinned OFF (doc/simulation.md): agents would
+    # add their own sensor hits to this soak's exact interest
+    # accounting; scripts/sim_soak.py is the sim plane's own soak.
+    global_settings.sim_enabled = False
     ctl = TPUSpatialController()
     ctl.load_config(dict(
         WorldOffsetX=0, WorldOffsetZ=0, GridWidth=100, GridHeight=100,

@@ -223,6 +223,11 @@ async def run_live_phase(p: TraceSoakParams, dump_dir: str) -> dict:
     # soak's envelope predates the device diff pass; the plane has its
     # own soak (scripts/sensor_soak.py).
     global_settings.queryplane_enabled = False
+    # Simulation plane pinned OFF (doc/simulation.md): an agent
+    # population would add its own crossings/census traffic to this
+    # soak's deterministic accounting; scripts/sim_soak.py is the sim
+    # plane's own soak.
+    global_settings.sim_enabled = False
     global_settings.tpu_entity_capacity = 256
     global_settings.tpu_query_capacity = 32
     global_settings.channel_settings = {

@@ -93,6 +93,9 @@ def _fresh_globals(tmp_path):
     slo_mod.reset_slo()
     obs_mod.reset_fleet_obs()
     opshttp_mod.reset_ops()
+    from channeld_tpu.sim import plane as sim_plane_mod
+
+    sim_plane_mod.reset_sim()
     assert not violations, (
         "runtime thread-affinity violations (doc/concurrency.md): "
         f"{violations}"

@@ -584,3 +584,170 @@ def test_query_engine_doc_drift_is_flagged(tmp_path):
     assert any("queryplane_ghost_knob" in e for e in errors)
     assert any("queryplane_rows_max" in e for e in errors)
     assert sum("no cross-link" in e for e in errors) == 4
+
+
+def _sim_bench_doc():
+    return {
+        "metric": "sim_100k_agents_on_device_zero_extra_transfers",
+        "agents": 100000,
+        "ticks": 30,
+        "steady": {"no_sim_tick_ms_p50": 13.5, "sim_tick_ms_p50": 42.9,
+                   "sim_overhead_ms_p50": 29.4, "sim_ticks_advanced": 30},
+        "transfers": {"no_sim_fetches_per_tick": 1.0,
+                      "sim_fetches_per_tick": 1.0, "extra_per_tick": 0.0,
+                      "census_tick_fetches": 4,
+                      "census_column_fetches": 4},
+        "census": {"agents": 100000, "movement_l1": 1.0,
+                   "verify_errors": 0, "ids_exact": True},
+        "ledgers": {"sim_rebuilds_verified": 1,
+                    "sim_device_rebuilds_total_verified": 1},
+    }
+
+
+def test_sim_bench_schema_gate(tmp_path):
+    """BENCH_SIM_*.json extra checks (doc/simulation.md): a clean
+    artifact passes; an under-scale population, a sim pass that
+    skipped ticks, any extra steady-tick transfer, a dirty census, and
+    a rebuild ledger!=metric mismatch are each flagged."""
+    import json
+
+    path = tmp_path / "BENCH_SIM_r99.json"
+    path.write_text(json.dumps(_sim_bench_doc()))
+    assert check_artifacts.check_artifacts(str(tmp_path)) == []
+
+    doc = _sim_bench_doc()
+    doc["agents"] = doc["census"]["agents"] = 50000
+    path.write_text(json.dumps(doc))
+    assert any("fewer than 100K agents" in e
+               for e in check_artifacts.check_artifacts(str(tmp_path)))
+
+    doc = _sim_bench_doc()
+    doc["steady"]["sim_ticks_advanced"] = 29
+    path.write_text(json.dumps(doc))
+    assert any("did not run every tick" in e
+               for e in check_artifacts.check_artifacts(str(tmp_path)))
+
+    doc = _sim_bench_doc()
+    doc["transfers"]["sim_fetches_per_tick"] = 2.0
+    doc["transfers"]["extra_per_tick"] = 1.0
+    path.write_text(json.dumps(doc))
+    errors = check_artifacts.check_artifacts(str(tmp_path))
+    assert any("not transfer-free" in e for e in errors)
+    assert any("does not match the no-sim loop" in e for e in errors)
+
+    doc = _sim_bench_doc()
+    doc["census"]["verify_errors"] = 3
+    path.write_text(json.dumps(doc))
+    assert any("rebuild not verified clean" in e
+               for e in check_artifacts.check_artifacts(str(tmp_path)))
+
+    doc = _sim_bench_doc()
+    doc["census"]["ids_exact"] = False
+    path.write_text(json.dumps(doc))
+    assert any("did not preserve every agent id" in e
+               for e in check_artifacts.check_artifacts(str(tmp_path)))
+
+    doc = _sim_bench_doc()
+    doc["ledgers"]["sim_device_rebuilds_total_verified"] = 0
+    path.write_text(json.dumps(doc))
+    assert any("double-entry sim_rebuilds_verified" in e
+               for e in check_artifacts.check_artifacts(str(tmp_path)))
+
+
+def _sim_soak_doc():
+    names = [
+        "steady: census transfer double-entry",
+        "stampede: crossings flowed through ordinary handover",
+        "guard: sim rebuild double-entry",
+        "kill9: restored census bit-identical to last journaled",
+        "kill9: replay counter double-entry",
+    ]
+    for phase in ("steady", "stampede", "guard", "epoch", "kill9"):
+        names.append(f"{phase}: zero agents lost from cell tables")
+        names.append(f"{phase}: zero agents duplicated in cell tables")
+    return {
+        "kind": "sim_soak",
+        "seed": 1,
+        "agents": 96,
+        "humans": 16,
+        "duration_s": 1.0,
+        "phases": {
+            "steady": {}, "stampede": {}, "guard": {}, "epoch": {},
+            "kill9": {"restored_hash": "ab" * 32},
+        },
+        "invariants": {
+            "ok": True,
+            "checks": [{"name": n, "ok": True, "detail": ""}
+                       for n in names],
+        },
+    }
+
+
+def test_sim_soak_schema_gate(tmp_path):
+    """SOAK_SIM_*.json extra checks (doc/simulation.md): a clean
+    artifact passes; a missing phase, a kill -9 record without the
+    bit-identical restored-census hash, and a dropped exact-census
+    invariant are each flagged."""
+    import json
+
+    path = tmp_path / "SOAK_SIM_r99.json"
+    path.write_text(json.dumps(_sim_soak_doc()))
+    assert check_artifacts.check_artifacts(str(tmp_path)) == []
+
+    doc = _sim_soak_doc()
+    del doc["phases"]["epoch"]
+    path.write_text(json.dumps(doc))
+    assert any("phase 'epoch' missing" in e
+               for e in check_artifacts.check_artifacts(str(tmp_path)))
+
+    doc = _sim_soak_doc()
+    doc["phases"]["kill9"] = {}
+    path.write_text(json.dumps(doc))
+    assert any("no restored census hash" in e
+               for e in check_artifacts.check_artifacts(str(tmp_path)))
+
+    doc = _sim_soak_doc()
+    doc["invariants"]["checks"] = [
+        c for c in doc["invariants"]["checks"]
+        if c["name"] != "kill9: zero agents lost from cell tables"
+    ]
+    path.write_text(json.dumps(doc))
+    assert any("missing invariant check "
+               "'kill9: zero agents lost from cell tables'" in e
+               for e in check_artifacts.check_artifacts(str(tmp_path)))
+
+
+def test_simulation_doc_matches_declared_knobs():
+    """doc/simulation.md's knob table documents exactly the sim_*
+    knobs core/settings.py declares, and the planes the population
+    rides (README, device recovery, query engine, chaos) cross-link
+    it."""
+    assert check_artifacts.check_simulation_doc() == []
+
+
+def test_simulation_doc_drift_is_flagged(tmp_path):
+    import shutil
+
+    doc_dir = tmp_path / "doc"
+    doc_dir.mkdir()
+    core = tmp_path / "channeld_tpu" / "core"
+    core.mkdir(parents=True)
+    shutil.copy(os.path.join(REPO, "channeld_tpu", "core", "settings.py"),
+                core / "settings.py")
+
+    errors = check_artifacts.check_simulation_doc(str(tmp_path))
+    assert errors and "missing" in errors[0]
+
+    (doc_dir / "simulation.md").write_text(
+        "# x\n\n| `sim_enabled` | `false` | on |\n"
+        "| `sim_ghost_knob` | `1` | phantom |\n"
+        "\nthe `sim_pass_ms` metric is NOT a knob\n"
+    )
+    errors = check_artifacts.check_simulation_doc(str(tmp_path))
+    # Every undeclared table row + every declared-but-untabled knob +
+    # all four missing cross-links are flagged; a metric reference
+    # outside the table is NOT mistaken for a knob.
+    assert any("sim_ghost_knob" in e for e in errors)
+    assert any("sim_census_every_ticks" in e for e in errors)
+    assert not any("sim_pass_ms" in e for e in errors)
+    assert sum("no cross-link" in e for e in errors) == 4
